@@ -1,0 +1,13 @@
+"""alphafold2_tpu: a TPU-native (JAX/XLA/pjit/Pallas) protein structure framework.
+
+Re-designed from scratch with the capabilities of the reference
+alphafold2-pytorch (lucidrains v0.0.33): axial-attention trunk over a pairwise
+residue representation cross-attending an MSA stream, distogram prediction,
+and structure realization (distogram -> MDS -> sidechain lift -> refinement)
+with alignment/quality metrics — built TPU-first: static shapes, scan/remat
+trunks, mesh-sharded pair maps, Pallas kernels for the sparse paths.
+"""
+
+__version__ = "0.1.0"
+
+from alphafold2_tpu import constants

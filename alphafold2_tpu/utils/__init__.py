@@ -1,0 +1,29 @@
+from alphafold2_tpu.utils.structure import (
+    DISTANCE_THRESHOLDS,
+    cdist,
+    center_distogram,
+    get_bucketed_distance_matrix,
+    nerf,
+    scn_backbone_mask,
+    scn_cloud_mask,
+    sidechain_container,
+)
+from alphafold2_tpu.utils.metrics import (
+    GDT,
+    Kabsch,
+    RMSD,
+    TMscore,
+    calc_phis,
+    gdt,
+    get_dihedral,
+    kabsch,
+    rmsd,
+    tmscore,
+)
+from alphafold2_tpu.utils.mds import (
+    MDScaling,
+    calc_phis_backbone,
+    mds,
+    mdscaling,
+    mdscaling_backbone,
+)

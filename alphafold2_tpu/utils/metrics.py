@@ -1,0 +1,177 @@
+"""Alignment and structure-quality metrics: Kabsch, RMSD, GDT, TMscore, dihedrals.
+
+Single-jnp, batched equivalents of reference ``alphafold2_pytorch/utils.py``:
+
+- :func:`get_dihedral`     <- utils.py:410-444 (get_dihedral_{torch,numpy})
+- :func:`calc_phis`        <- utils.py:446-517
+- :func:`kabsch`           <- utils.py:523-567
+- :func:`rmsd`/:func:`gdt`/:func:`tmscore` <- utils.py:572-633
+- public ``Kabsch``/``RMSD``/``GDT``/``TMscore`` wrappers <- utils.py:707-770
+
+The reference implements each twice (torch + numpy) with a runtime dispatch
+decorator chain (utils.py:42-85, 680-770); jnp accepts numpy arrays directly so
+one implementation serves both, and the public wrappers keep only the useful
+part of that API: automatic batch-dim expansion. A ``backend`` kwarg is
+accepted (ignored) for drop-in compatibility.
+
+Differentiability: the SVD inside Kabsch is computed on a stop_gradient'd
+covariance (degenerate singular values give NaN grads on every backend; the
+reference detaches too, utils.py:533). The rotation is applied to live
+tensors, so gradients flow through everything except the rotation itself.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GDT_TS_CUTOFFS = (1.0, 2.0, 4.0, 8.0)
+GDT_HA_CUTOFFS = (0.5, 1.0, 2.0, 4.0)
+
+
+def _expand_to(t: jnp.ndarray, length: int) -> jnp.ndarray:
+    if length <= 0:
+        return t
+    return t.reshape((1,) * length + t.shape)
+
+
+def get_dihedral(c1, c2, c3, c4) -> jnp.ndarray:
+    """Dihedral angle (radians) for four points, batched over leading dims.
+
+    atan2 polymer-physics formula (reference utils.py:410-426). Inputs (..., 3),
+    output (...,).
+    """
+    u1 = c2 - c1
+    u2 = c3 - c2
+    u3 = c4 - c3
+    y = jnp.sum(
+        jnp.linalg.norm(u2, axis=-1, keepdims=True) * u1 * jnp.cross(u2, u3), axis=-1
+    )
+    x = jnp.sum(jnp.cross(u1, u2) * jnp.cross(u2, u3), axis=-1)
+    return jnp.arctan2(y, x)
+
+
+def calc_phis(
+    pred_coords: jnp.ndarray,
+    N_mask: jnp.ndarray,
+    CA_mask: jnp.ndarray,
+    C_mask: jnp.ndarray | None = None,
+    prop: bool = True,
+):
+    """Backbone phi angles (or proportion < 0) used for MDS mirror detection.
+
+    pred_coords: (B, 3, L_atoms); masks: (L_atoms,) bool over the flat atom
+    stream. Boolean-mask gathers make this host-side (not jit-traceable) —
+    it runs once per structure realization, off the hot path, exactly like
+    the reference (utils.py:446-480, gradients detached there too).
+    """
+    coords = jnp.swapaxes(jax.lax.stop_gradient(pred_coords), -1, -2)  # (B, L, 3)
+    N_mask = jnp.asarray(N_mask).reshape(-1)
+    CA_mask = jnp.asarray(CA_mask).reshape(-1)
+    n_terms = coords[:, N_mask]
+    c_alphas = coords[:, CA_mask]
+    if C_mask is not None:
+        c_terms = coords[:, jnp.asarray(C_mask).reshape(-1)]
+    else:
+        c_terms = coords[:, ~(N_mask | CA_mask)]
+    phis = get_dihedral(
+        c_terms[:, :-1], n_terms[:, 1:], c_alphas[:, 1:], c_terms[:, 1:]
+    )  # (B, L-1)
+    if prop:
+        return jnp.mean((phis < 0).astype(jnp.float32), axis=-1)
+    return phis
+
+
+def kabsch(X: jnp.ndarray, Y: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Kabsch-align X onto Y. Both (..., 3, N). Returns (X_aligned, Y_centered).
+
+    Batched over leading dims (the reference is single-structure only,
+    utils.py:523-544). SVD on a detached covariance, determinant sign fix via
+    where (no data-dependent python branch — jit/vmap safe).
+    """
+    Xc = X - X.mean(axis=-1, keepdims=True)
+    Yc = Y - Y.mean(axis=-1, keepdims=True)
+    C = jnp.einsum("...dn,...en->...de", Xc, Yc)
+    U, S, Vt = jnp.linalg.svd(jax.lax.stop_gradient(C))
+    # sign correction for proper rotation
+    d = jnp.linalg.det(U) * jnp.linalg.det(Vt)
+    flip = (d < 0.0)[..., None]
+    U = jnp.concatenate([U[..., :-1], jnp.where(flip, -U[..., -1:], U[..., -1:])], axis=-1)
+    R = jnp.einsum("...ij,...jk->...ik", U, Vt)
+    X_aligned = jnp.einsum("...nd,...de->...en", jnp.swapaxes(Xc, -1, -2), R)
+    return X_aligned, Yc
+
+
+def rmsd(X: jnp.ndarray, Y: jnp.ndarray) -> jnp.ndarray:
+    """RMSD over (..., D, N) -> (...,). Reference utils.py:572-578."""
+    return jnp.sqrt(jnp.mean((X - Y) ** 2, axis=(-1, -2)))
+
+
+def gdt(X, Y, cutoffs, weights=None) -> jnp.ndarray:
+    """GDT over (..., D, N) -> (...,): weighted mean of per-cutoff fractions.
+
+    Vectorized over cutoffs (reference loops, utils.py:594-595).
+    """
+    cutoffs = jnp.asarray(cutoffs, dtype=X.dtype)
+    if weights is None:
+        weights = jnp.ones_like(cutoffs)
+    else:
+        weights = jnp.asarray(weights, dtype=X.dtype)
+    dist = jnp.sqrt(jnp.sum((X - Y) ** 2, axis=-2))  # (..., N)
+    frac = jnp.mean(
+        (dist[..., None, :] <= cutoffs[:, None]).astype(X.dtype), axis=-1
+    )  # (..., K)
+    return jnp.mean(frac * weights, axis=-1)
+
+
+def tmscore(X: jnp.ndarray, Y: jnp.ndarray) -> jnp.ndarray:
+    """TM-score over (..., D, N) -> (...,); d0 = 1.24*cbrt(L-15) - 1.8."""
+    L = X.shape[-1]
+    d0 = 1.24 * np.cbrt(max(L - 15, 0.1)) - 1.8
+    dist = jnp.sqrt(jnp.sum((X - Y) ** 2, axis=-2))
+    return jnp.mean(1.0 / (1.0 + (dist / d0) ** 2), axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Public API wrappers: accept (D, N) or (B, D, N), numpy or jax arrays.
+# Names match the reference's exports (utils.py:707-770).
+# ---------------------------------------------------------------------------
+
+
+def _normalize_pair(A, B, dim_len):
+    A = jnp.asarray(A)
+    B = jnp.asarray(B)
+    assert A.ndim == B.ndim, "Shapes of A and B must match."
+    A = _expand_to(A, dim_len - A.ndim)
+    B = _expand_to(B, dim_len - B.ndim)
+    return A, B
+
+
+def Kabsch(A, B, backend: str = "auto"):
+    """Kabsch-rotate A into B; inputs (3, N) or (B, 3, N)."""
+    del backend
+    A, B = _normalize_pair(A, B, 3)
+    X, Y = kabsch(A, B)
+    if X.shape[0] == 1:
+        return X[0], Y[0]
+    return X, Y
+
+
+def RMSD(A, B, backend: str = "auto"):
+    del backend
+    A, B = _normalize_pair(A, B, 3)
+    return rmsd(A, B)
+
+
+def GDT(A, B, mode: str = "TS", weights=None, backend: str = "auto"):
+    del backend
+    A, B = _normalize_pair(A, B, 3)
+    cutoffs = GDT_HA_CUTOFFS if mode.lower() == "ha" else GDT_TS_CUTOFFS
+    return gdt(A, B, cutoffs, weights=weights)
+
+
+def TMscore(A, B, backend: str = "auto"):
+    del backend
+    A, B = _normalize_pair(A, B, 3)
+    return tmscore(A, B)

@@ -1,0 +1,22 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Must set env before jax's backend initializes — this conftest is imported by
+pytest before any test module. Multi-device sharding tests rely on the 8
+virtual CPU devices (the reference has no distributed tests at all; this is
+the fake-backend mechanism SURVEY.md S4 calls for). Set AF2TPU_TEST_TPU=1 to
+run the suite on real accelerators instead.
+"""
+
+import os
+
+if not os.environ.get("AF2TPU_TEST_TPU"):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
