@@ -1,0 +1,3 @@
+from alphafold2_tpu.models.alphafold2 import Alphafold2, TemplateBlock
+from alphafold2_tpu.models.trunk import Trunk, TrunkLayer
+from alphafold2_tpu.models.se3 import SE3Refiner, SE3TemplateEmbedder, SE3Transformer

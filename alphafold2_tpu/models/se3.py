@@ -1,0 +1,208 @@
+"""SE(3)-equivariant attention over point clouds, degrees {0, 1}.
+
+TPU-native replacement for the external ``se3-transformer-pytorch`` dependency
+at both reference call sites:
+
+- template sidechain coloring (reference alphafold2.py:372-384, 519-537):
+  scalar residue embeddings + one type-1 (vector) sidechain feature at
+  template coords -> colored scalar embeddings (``return_type=0``)
+- end-to-end coordinate refiner (reference train_end2end.py:86-94, 168-169):
+  atom-token scalars at proto-structure coords -> refined coords (type-1 out)
+
+Both sites use only degree-0 and degree-1 features (SURVEY.md S7 "hard
+parts"), so instead of a spherical-harmonic SE(3)-Transformer this is a
+geometric vector attention network: all interactions go through rotation
+invariants (scalar features, pairwise distances) and rotation-covariant
+linear maps (channel-mixing of vectors, relative-position directions), which
+is exactly equivariant under SE(3) by construction.
+
+TPU-first choices: dense all-pairs attention with an RBF distance bias in
+place of the reference's 12-nearest-neighbor graph gathers (dynamic gathers
+are hostile to XLA; N here is a few hundred, so dense attention is a clean
+MXU matmul), static shapes throughout. Equivariance is verified numerically
+in tests/test_se3.py (the reference has no such test).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from alphafold2_tpu.ops.attention import MASK_VALUE
+
+
+def _safe_norm(v, axis=-1, keepdims=False, eps=1e-8):
+    sq = jnp.sum(v * v, axis=axis, keepdims=keepdims)
+    return jnp.sqrt(sq + eps)
+
+
+class RadialBasis(nn.Module):
+    """Distances -> smooth RBF features (invariant edge descriptors)."""
+
+    num_basis: int = 16
+    max_dist: float = 20.0
+
+    @nn.compact
+    def __call__(self, dist):
+        centers = jnp.linspace(0.0, self.max_dist, self.num_basis)
+        width = self.max_dist / self.num_basis
+        return jnp.exp(-(((dist[..., None] - centers) / width) ** 2))
+
+
+class EquivariantLayer(nn.Module):
+    """One block: invariant attention + scalar/vector residual updates.
+
+    Scalars s: (B, N, ds); vectors v: (B, N, dv, 3); coords: (B, N, 3).
+    Attention logits are built from scalars and RBF(distance) only
+    (invariant); value aggregation mixes neighbor vectors and relative
+    directions gated by invariant scalars (covariant).
+    """
+
+    dim: int
+    vec_dim: int = 16
+    heads: int = 4
+    num_basis: int = 16
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, s, v, coords, mask=None):
+        b, n, ds = s.shape
+        h = self.heads
+        dh = self.dim // h
+
+        rel = coords[:, :, None, :] - coords[:, None, :, :]  # (B, N, N, 3)
+        dist = _safe_norm(rel)  # (B, N, N)
+        unit = rel / dist[..., None]
+        rbf = RadialBasis(self.num_basis)(dist).astype(self.dtype)  # (B,N,N,R)
+
+        sn = nn.LayerNorm(dtype=self.dtype, name="s_norm")(s)
+        q = nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="q")(sn)
+        k = nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="k")(sn)
+        q = q.reshape(b, n, h, dh)
+        k = k.reshape(b, n, h, dh)
+        logits = jnp.einsum("bihd,bjhd->bhij", q, k) * dh**-0.5
+        logits = logits + jnp.moveaxis(
+            nn.Dense(h, dtype=self.dtype, name="rbf_bias")(rbf), -1, 1
+        )
+        if mask is not None:
+            pair = mask[:, None, None, :] & mask[:, None, :, None]
+            logits = jnp.where(pair, logits, MASK_VALUE)
+        attn = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(self.dtype)
+        attn_mean = attn.mean(axis=1)  # (B, N, N) head-averaged for vector agg
+
+        # scalar update: attended neighbor scalars + invariant vector norms
+        vals = nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="val")(sn)
+        vals = vals.reshape(b, n, h, dh)
+        s_agg = jnp.einsum("bhij,bjhd->bihd", attn, vals).reshape(b, n, self.dim)
+        v_norms = _safe_norm(v)  # (B, N, dv) invariant
+        s_in = jnp.concatenate([s_agg, v_norms.astype(self.dtype)], axis=-1)
+        s = s + nn.Dense(ds, dtype=self.dtype, name="s_out")(s_in)
+
+        # vector update: equivariant combination of
+        #   (a) channel-mixed own vectors, (b) attended neighbor vectors,
+        #   (c) attended relative directions — each gated by invariant scalars
+        gates = nn.Dense(3 * self.vec_dim, dtype=self.dtype, name="gates")(
+            nn.LayerNorm(dtype=self.dtype, name="s_norm2")(s)
+        )
+        g_self, g_nbr, g_rel = jnp.split(gates, 3, axis=-1)
+
+        v_mix = nn.DenseGeneral(
+            features=self.vec_dim, axis=-1, use_bias=False, dtype=self.dtype, name="v_mix"
+        )(jnp.swapaxes(v, -1, -2))  # (B, N, 3, dv) channel-mixed
+        v_mix = jnp.swapaxes(v_mix, -1, -2)  # (B, N, dv, 3)
+
+        v_nbr = jnp.einsum("bij,bjcd->bicd", attn_mean, v_mix)  # (B, N, dv, 3)
+        edge_gate = nn.Dense(self.vec_dim, dtype=self.dtype, name="edge_gate")(rbf)
+        v_rel = jnp.einsum("bij,bijc,bijd->bicd", attn_mean, edge_gate, unit)
+
+        v = v + (
+            g_self[..., None] * v_mix
+            + g_nbr[..., None] * v_nbr
+            + g_rel[..., None] * v_rel
+        )
+        return s, v
+
+
+class SE3Transformer(nn.Module):
+    """Stack of equivariant layers over (scalars, vectors, coords)."""
+
+    dim: int
+    depth: int = 4
+    vec_dim: int = 16
+    heads: int = 4
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, s, v, coords, mask=None):
+        for i in range(self.depth):
+            s, v = EquivariantLayer(
+                dim=self.dim, vec_dim=self.vec_dim, heads=self.heads,
+                dtype=self.dtype, name=f"layer_{i}",
+            )(s, v, coords, mask=mask)
+        return s, v
+
+
+class SE3TemplateEmbedder(nn.Module):
+    """Color residue embeddings with sidechain direction features.
+
+    s: (B, N, dim) residue scalars; sidechain: (B, N, 3) type-1 feature
+    (e.g. C -> C-alpha unit vectors); coords: (B, N, 3). Returns colored
+    (B, N, dim) scalars — the ``return_type=0`` call of the reference
+    (alphafold2.py:530-535).
+    """
+
+    dim: int
+    depth: int = 2
+    vec_dim: int = 8
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, s, sidechain, coords, mask=None):
+        # lift the single type-1 feature to vec_dim channels with learned
+        # (invariant) per-channel scales
+        scales = self.param(
+            "sidechain_proj", nn.initializers.normal(1.0), (self.vec_dim,)
+        )
+        v = sidechain[:, :, None, :] * scales[None, None, :, None].astype(
+            sidechain.dtype
+        )
+        s, _ = SE3Transformer(
+            dim=self.dim, depth=self.depth, vec_dim=self.vec_dim,
+            dtype=self.dtype, name="net",
+        )(s, v, coords, mask=mask)
+        return s
+
+
+class SE3Refiner(nn.Module):
+    """Equivariant coordinate refiner (the end-to-end pipeline's final stage).
+
+    tokens: (B, N) int atom/residue tokens; coords: (B, N, 3) proto-structure.
+    Returns refined coords (B, N, 3) = coords + equivariant delta — the
+    type-1 output call of the reference (train_end2end.py:86-94,168-169).
+    """
+
+    dim: int = 64
+    depth: int = 2
+    vec_dim: int = 8
+    num_tokens: int = 32
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, coords, mask=None):
+        s = nn.Embed(self.num_tokens, self.dim, dtype=self.dtype, name="token_emb")(
+            tokens
+        )
+        v = jnp.zeros((*coords.shape[:2], self.vec_dim, 3), dtype=coords.dtype)
+        s, v = SE3Transformer(
+            dim=self.dim, depth=self.depth, vec_dim=self.vec_dim,
+            dtype=self.dtype, name="net",
+        )(s, v, coords, mask=mask)
+        delta = nn.DenseGeneral(
+            features=1, axis=-1, use_bias=False, dtype=self.dtype, name="to_delta"
+        )(jnp.swapaxes(v, -1, -2))[..., 0]  # (B, N, 3)
+        if mask is not None:
+            delta = jnp.where(mask[..., None], delta, 0.0)
+        return coords + delta

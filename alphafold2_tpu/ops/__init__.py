@@ -1,0 +1,1 @@
+from alphafold2_tpu.ops.attention import Attention, AxialAttention, FeedForward

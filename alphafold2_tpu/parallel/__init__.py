@@ -1,0 +1,10 @@
+from alphafold2_tpu.parallel.sharding import (
+    DATA_AXIS,
+    SEQ_AXIS,
+    active_mesh,
+    make_mesh,
+    shard_batch,
+    shard_msa,
+    shard_pair,
+    use_mesh,
+)

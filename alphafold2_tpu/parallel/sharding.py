@@ -1,0 +1,105 @@
+"""Sharding constraints for the pair/MSA streams over a device mesh.
+
+The reference has no multi-device parallelism of any kind (SURVEY.md S2.3);
+this module is the green-field capability layer. Design (scaling-book recipe):
+
+- Mesh axes: ``dp`` (data parallel over batch) x ``sp`` (sequence parallel
+  over pair-map rows). The pair grid (B, N, N, D) is sharded
+  P(dp, sp, None, None): each device holds a contiguous band of rows i with
+  all columns j — so the *row* attention pass (attend over j) is fully local.
+  The *column* pass needs all i per column; annotating the layer-boundary
+  constraint and leaving the interior unconstrained lets XLA insert the
+  all-to-all transposes between the two passes (the ring/Ulysses-adjacent
+  design SURVEY.md S7 calls for) over ICI.
+- The MSA grid (B, M, Nm, D) is tiny next to the N^2 pair grid (M <= 20);
+  it is replicated across ``sp`` and sharded only over ``dp``.
+- Cross-attention (N^2 queries vs M*Nm keys) keeps pair tokens row-sharded;
+  the MSA context is replicated so no gather is needed on the KV side.
+
+Blocks call :func:`shard_pair`/:func:`shard_msa` at their boundaries; outside
+an active mesh context these are identity, so the same model code runs
+single-chip, under tests, and on a pod.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "dp"
+SEQ_AXIS = "sp"
+
+_active: dict = {"mesh": None}
+
+
+def make_mesh(
+    n_data: Optional[int] = None, n_seq: int = 1, devices=None
+) -> Mesh:
+    """Create a (dp, sp) mesh. Defaults to all devices on the dp axis."""
+    import numpy as np
+
+    devices = devices if devices is not None else jax.devices()
+    if n_data is None:
+        n_data = len(devices) // n_seq
+    assert n_data * n_seq == len(devices), (
+        f"mesh {n_data}x{n_seq} != {len(devices)} devices"
+    )
+    arr = np.asarray(devices).reshape(n_data, n_seq)
+    return Mesh(arr, (DATA_AXIS, SEQ_AXIS))
+
+
+@contextmanager
+def use_mesh(mesh: Mesh):
+    """Activate sharding constraints for model code traced inside."""
+    prev = _active["mesh"]
+    _active["mesh"] = mesh
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _active["mesh"] = prev
+
+
+def active_mesh() -> Optional[Mesh]:
+    return _active["mesh"]
+
+
+def _constrain(x, spec: P):
+    mesh = _active["mesh"]
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def pair_spec() -> P:
+    return P(DATA_AXIS, SEQ_AXIS)
+
+
+def msa_spec() -> P:
+    return P(DATA_AXIS)
+
+
+def batch_spec() -> P:
+    return P(DATA_AXIS)
+
+
+def shard_pair(x):
+    """Constrain a (B, N, N, D) or (B, N, N) pair array: batch x row sharded."""
+    return _constrain(x, pair_spec())
+
+
+def shard_msa(m):
+    """Constrain a (B, M, Nm, D) MSA array: batch sharded, replicated on sp."""
+    return _constrain(m, msa_spec())
+
+
+def shard_batch(t):
+    """Constrain any batch-leading array to data-parallel sharding."""
+    return _constrain(t, batch_spec())
+
+
+def replicated(t):
+    return _constrain(t, P())

@@ -96,7 +96,7 @@ def kabsch(X: jnp.ndarray, Y: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     U, S, Vt = jnp.linalg.svd(jax.lax.stop_gradient(C))
     # sign correction for proper rotation
     d = jnp.linalg.det(U) * jnp.linalg.det(Vt)
-    flip = (d < 0.0)[..., None]
+    flip = (d < 0.0)[..., None, None]
     U = jnp.concatenate([U[..., :-1], jnp.where(flip, -U[..., -1:], U[..., -1:])], axis=-1)
     R = jnp.einsum("...ij,...jk->...ik", U, Vt)
     X_aligned = jnp.einsum("...nd,...de->...en", jnp.swapaxes(Xc, -1, -2), R)
