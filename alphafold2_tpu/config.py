@@ -1,0 +1,117 @@
+"""Dataclass config tree + CLI parsing.
+
+The reference has no config system at all — hyperparameters are module-level
+constants edited in-source (train_pre.py:13-24, train_end2end.py:22-28,
+constants.py:5-14) and model config is ctor kwargs (alphafold2.py:330-350).
+SURVEY.md S5.6 calls for a real config system; this is it: typed dataclasses,
+flat ``--section.field=value`` CLI overrides, JSON round-trip for
+checkpointing reproducibility.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+@dataclass
+class ModelConfig:
+    dim: int = 256
+    max_seq_len: int = 2048
+    depth: int = 6
+    heads: int = 8
+    dim_head: int = 64
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
+    remat: bool = False
+    sparse_self_attn: bool = False
+    cross_attn_compress_ratio: int = 1
+    msa_tie_row_attn: bool = False
+    template_attn_depth: int = 2
+    bfloat16: bool = True  # compute dtype on TPU
+
+
+@dataclass
+class MeshConfig:
+    data_parallel: int = 1  # dp axis size; -1 = fill with all devices
+    seq_parallel: int = 1  # sp axis size (pair-map row sharding)
+
+
+@dataclass
+class DataConfig:
+    crop_len: int = 128  # residues per crop (static shape)
+    msa_depth: int = 5
+    msa_len: int = 64
+    batch_size: int = 1
+    max_len_filter: int = 250  # drop chains longer than this (train_pre.py:47)
+    min_len_filter: int = 16
+    source: str = "synthetic"  # "synthetic" | "sidechainnet" | "scn_sharded"
+    casp_version: int = 12
+    thinning: int = 30
+    data_dir: Optional[str] = None
+
+
+@dataclass
+class TrainConfig:
+    learning_rate: float = 3e-4  # train_pre.py:18
+    num_steps: int = 100000  # train_pre.py:14 NUM_BATCHES
+    gradient_accumulate_every: int = 16  # train_pre.py:16
+    warmup_steps: int = 1000
+    weight_decay: float = 0.0
+    seed: int = 0
+    log_every: int = 50
+    checkpoint_every: int = 1000
+    checkpoint_dir: Optional[str] = None
+    keep_checkpoints: int = 3
+    profile_dir: Optional[str] = None  # jax.profiler trace output
+    profile_steps: Tuple[int, int] = (10, 13)
+
+
+@dataclass
+class Config:
+    model: ModelConfig = field(default_factory=ModelConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        raw = json.loads(s)
+        return cls(
+            model=ModelConfig(**raw.get("model", {})),
+            mesh=MeshConfig(**raw.get("mesh", {})),
+            data=DataConfig(**raw.get("data", {})),
+            train=TrainConfig(**raw.get("train", {})),
+        )
+
+    def apply_overrides(self, overrides: list[str]) -> "Config":
+        """Apply ``section.field=value`` strings (CLI) onto a copy."""
+        cfg = dataclasses.replace(self)
+        for item in overrides:
+            key, _, value = item.partition("=")
+            key = key.lstrip("-")
+            section_name, _, field_name = key.partition(".")
+            section = getattr(cfg, section_name)
+            if not hasattr(section, field_name):
+                raise KeyError(f"unknown config field {key!r}")
+            current = getattr(section, field_name)
+            if isinstance(current, bool):
+                parsed = value.lower() in ("1", "true", "yes")
+            elif isinstance(current, int):
+                parsed = int(value)
+            elif isinstance(current, float):
+                parsed = float(value)
+            else:
+                parsed = value
+            setattr(section, field_name, parsed)
+        return cfg
+
+
+def parse_cli(argv: list[str], base: Optional[Config] = None) -> Config:
+    cfg = base or Config()
+    return cfg.apply_overrides([a for a in argv if "=" in a])

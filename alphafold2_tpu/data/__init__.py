@@ -1,0 +1,5 @@
+from alphafold2_tpu.data.pipeline import (
+    SidechainnetDataset,
+    SyntheticDataset,
+    make_dataset,
+)

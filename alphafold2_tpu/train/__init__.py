@@ -1,0 +1,10 @@
+from alphafold2_tpu.train.loop import (
+    TrainState,
+    build_model,
+    build_optimizer,
+    device_put_batch,
+    distogram_cross_entropy,
+    init_state,
+    make_train_step,
+    train,
+)

@@ -1,0 +1,254 @@
+"""End-to-end structure training: distogram -> 3D coords -> refine -> RMSD loss.
+
+The reference's ``train_end2end.py`` is a non-running design sketch (7 distinct
+crash bugs, SURVEY.md S2.5); this module implements that *intent* (SURVEY.md
+S3.4), corrected and compiled as ONE jitted differentiable program:
+
+  elongate residues x3 into (N, CA, C) atom tokens  (train_end2end.py:134-146)
+  -> Alphafold2 distogram over the 3L x 3L atom grid (:149)
+  -> softmax (the reference feeds raw logits to centering, a bug)
+  -> center_distogram -> distances + confidence weights (:152)
+  -> MDS (Guttman scan) with per-element chirality fix (:154-160)
+  -> sidechain_container lift to the 14-atom cloud (:163)
+  -> SE(3)-equivariant refiner over the atom point cloud (:168-169)
+  -> Kabsch-align vs ground truth, RMSD + 0.1*||1/w - 1|| loss (:172-176)
+
+Gradients flow through the whole chain (MDS iterations are differentiable;
+the chirality decision and Kabsch rotation are computed on stopped gradients,
+matching the reference's detach points utils.py:463,533).
+
+TPU-first: everything static-shape; the MDS loop is a fixed-trip lax.scan;
+elongation is a static reshape; the only non-jnp control flow is the python
+driver loop.
+"""
+
+from __future__ import annotations
+
+from contextlib import nullcontext
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from alphafold2_tpu import constants
+from alphafold2_tpu.config import Config
+from alphafold2_tpu.models.alphafold2 import Alphafold2
+from alphafold2_tpu.models.se3 import SE3Refiner
+from alphafold2_tpu.parallel.sharding import DATA_AXIS, use_mesh
+from alphafold2_tpu.train.loop import TrainState, build_optimizer
+from alphafold2_tpu.utils.mds import mdscaling_backbone
+from alphafold2_tpu.utils.metrics import kabsch
+from alphafold2_tpu.utils.structure import center_distogram, sidechain_container
+
+
+def elongate(seq: jnp.ndarray, mask: jnp.ndarray):
+    """Repeat each residue token x3 -> (N, CA, C) atom-level stream.
+
+    (B, L) -> (B, 3L); the reference builds this with a python loop over
+    tokens (train_end2end.py:134-146) — here it is a broadcast+reshape.
+    """
+    b, l = seq.shape
+    seq3 = jnp.broadcast_to(seq[:, :, None], (b, l, 3)).reshape(b, 3 * l)
+    mask3 = jnp.broadcast_to(mask[:, :, None], (b, l, 3)).reshape(b, 3 * l)
+    return seq3, mask3
+
+
+class End2EndModel(nn.Module):
+    """Alphafold2 trunk + differentiable structure realization + SE(3) refiner."""
+
+    dim: int = 256
+    depth: int = 1
+    heads: int = 8
+    dim_head: int = 64
+    max_seq_len: int = 2048
+    mds_iters: int = 200
+    refiner_depth: int = 2
+    remat: bool = False
+    msa_tie_row_attn: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, seq, msa=None, mask=None, msa_mask=None, embedds=None,
+                 mds_key=None, deterministic: bool = True):
+        b, l = seq.shape
+        seq3, mask3 = elongate(seq, mask)
+
+        logits = Alphafold2(
+            dim=self.dim, depth=self.depth, heads=self.heads,
+            dim_head=self.dim_head, max_seq_len=self.max_seq_len,
+            remat=self.remat, msa_tie_row_attn=self.msa_tie_row_attn,
+            dtype=self.dtype, name="af2",
+        )(seq3, msa, mask=mask3, msa_mask=msa_mask, embedds=embedds,
+          deterministic=deterministic)
+
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        distances, weights = center_distogram(probs)
+        if mds_key is None:
+            mds_key = jax.random.key(0)
+        coords, _ = mdscaling_backbone(
+            distances, weights=weights, iters=self.mds_iters, key=mds_key
+        )  # (B, 3, 3L)
+
+        backbone = jnp.swapaxes(coords, -1, -2)  # (B, 3L, 3)
+        proto = sidechain_container(backbone, place_oxygen=True)  # (B, L, 14, 3)
+
+        atom_tokens = jnp.broadcast_to(
+            jnp.arange(constants.NUM_COORDS_PER_RES)[None, None],
+            (b, l, constants.NUM_COORDS_PER_RES),
+        ).reshape(b, -1)
+        atom_mask = jnp.broadcast_to(
+            mask[:, :, None], (b, l, constants.NUM_COORDS_PER_RES)
+        ).reshape(b, -1)
+        refined = SE3Refiner(
+            dim=64, depth=self.refiner_depth,
+            num_tokens=constants.NUM_COORDS_PER_RES, dtype=self.dtype,
+            name="refiner",
+        )(atom_tokens, proto.reshape(b, -1, 3), mask=atom_mask)
+        refined = refined.reshape(b, l, constants.NUM_COORDS_PER_RES, 3)
+
+        return {
+            "distogram": logits,
+            "distances": distances,
+            "weights": weights,
+            "proto": proto,
+            "refined": refined,
+        }
+
+
+def structure_loss(out: dict, backbone_true: jnp.ndarray, mask: jnp.ndarray):
+    """Kabsch-aligned backbone RMSD + distogram-dispersion regularizer
+    (reference train_end2end.py:172-176)."""
+    refined_bb = out["refined"][:, :, :3].reshape(backbone_true.shape)  # (B, 3L, 3)
+    pred = jnp.swapaxes(refined_bb, -1, -2)  # (B, 3, 3L)
+    true = jnp.swapaxes(backbone_true, -1, -2)
+    mask3 = jnp.broadcast_to(mask[:, :, None], (*mask.shape, 3)).reshape(
+        mask.shape[0], -1
+    )
+    # zero masked atoms on both sides so they do not skew the alignment
+    pred = pred * mask3[:, None, :]
+    true = true * mask3[:, None, :]
+    aligned, centered = kabsch(pred, true)
+    denom = jnp.maximum(mask3.sum(-1), 1)
+    sq = jnp.sum((aligned - centered) ** 2, axis=-2) * mask3
+    rmsd_val = jnp.sqrt(jnp.sum(sq, axis=-1) / denom)
+    w = out["weights"]
+    disp = jnp.mean(jnp.abs(1.0 / jnp.clip(w, 1e-7, None) - 1.0) * (w > 0), axis=(-1, -2))
+    return jnp.mean(rmsd_val + 0.1 * disp), {
+        "rmsd": jnp.mean(rmsd_val),
+        "dispersion": jnp.mean(disp),
+    }
+
+
+def make_end2end_step(model: End2EndModel, mesh: Optional[Mesh] = None):
+    def step(state: TrainState, batch: dict, rng: jax.Array):
+        ctx = use_mesh(mesh) if mesh is not None else nullcontext()
+        with ctx:
+            drop_rng, mds_rng = jax.random.split(rng)
+
+            def loss_fn(params):
+                out = model.apply(
+                    params,
+                    batch["seq"],
+                    batch["msa"],
+                    mask=batch["mask"],
+                    msa_mask=batch["msa_mask"],
+                    mds_key=mds_rng,
+                    deterministic=False,
+                    rngs={"dropout": drop_rng},
+                )
+                return structure_loss(out, batch["backbone"], batch["mask"])
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params
+            )
+            grads_ok = jnp.all(
+                jnp.asarray(
+                    [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]
+                )
+            )
+            safe = jax.tree.map(
+                lambda g: jnp.where(grads_ok, g, jnp.zeros_like(g)), grads
+            )
+            new_state = state.apply_gradients(grads=safe)
+            new_state = new_state.replace(
+                skipped=state.skipped + jnp.where(grads_ok, 0, 1)
+            )
+            return new_state, {
+                "loss": loss,
+                "grad_norm": optax.global_norm(grads),
+                "grads_ok": grads_ok,
+                **aux,
+            }
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=0)
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.jit(
+        step, in_shardings=(repl, data, repl), out_shardings=(repl, repl),
+        donate_argnums=0,
+    )
+
+
+def init_end2end_state(cfg: Config, model: End2EndModel, batch: dict) -> TrainState:
+    rng = jax.random.key(cfg.train.seed)
+    params = model.init(
+        rng,
+        jnp.asarray(batch["seq"]),
+        jnp.asarray(batch["msa"]),
+        mask=jnp.asarray(batch["mask"]),
+        msa_mask=jnp.asarray(batch["msa_mask"]),
+    )
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=params,
+        tx=build_optimizer(cfg),
+        skipped=jnp.zeros((), jnp.int32),
+    )
+
+
+def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
+    """Runnable end-to-end driver (the reference's never-ran intent)."""
+    import time
+
+    from alphafold2_tpu.data.pipeline import make_dataset
+    from alphafold2_tpu.parallel.sharding import make_mesh
+    from alphafold2_tpu.train.loop import device_put_batch
+    from alphafold2_tpu.train.observe import MetricsLogger
+
+    num_steps = num_steps or cfg.train.num_steps
+    dataset = dataset or make_dataset(cfg.data, seed=cfg.train.seed)
+    data_iter = iter(dataset)
+    mesh = None
+    if cfg.mesh.data_parallel * cfg.mesh.seq_parallel > 1:
+        mesh = make_mesh(cfg.mesh.data_parallel, cfg.mesh.seq_parallel)
+
+    model = End2EndModel(
+        dim=cfg.model.dim, depth=cfg.model.depth, heads=cfg.model.heads,
+        dim_head=cfg.model.dim_head, max_seq_len=cfg.model.max_seq_len,
+        remat=cfg.model.remat, msa_tie_row_attn=cfg.model.msa_tie_row_attn,
+        dtype=jnp.bfloat16 if cfg.model.bfloat16 else jnp.float32,
+    )
+    sample = next(data_iter)
+    state = init_end2end_state(cfg, model, sample)
+    step_fn = make_end2end_step(model, mesh)
+    logger = MetricsLogger(cfg.train.checkpoint_dir)
+    rng = jax.random.key(cfg.train.seed + 1)
+
+    batch = device_put_batch(sample, mesh)
+    t0 = time.perf_counter()
+    for i in range(num_steps):
+        rng, r = jax.random.split(rng)
+        state, metrics = step_fn(state, batch, r)
+        if (i + 1) % cfg.train.log_every == 0 or i == 0:
+            m = {k: float(v) for k, v in metrics.items()}
+            m["steps_per_sec"] = (
+                cfg.train.log_every / (time.perf_counter() - t0) if i else 0.0
+            )
+            t0 = time.perf_counter()
+            logger.log(i, m)
+        batch = device_put_batch(next(data_iter), mesh)
+    return state

@@ -1,0 +1,243 @@
+"""Training: losses, state, pjit-sharded train step, distogram pretraining.
+
+Capability target: reference ``train_pre.py`` (distogram pretraining loop:
+cross-entropy vs bucketed CA distances with ignore_index -100, Adam 3e-4,
+gradient accumulation 16 — train_pre.py:13-24, 66-95) re-designed TPU-first:
+
+- the whole step (forward, loss, backward, optimizer) is ONE jitted program
+  laid out over a (dp, sp) mesh; batch enters data-parallel-sharded, params
+  and optimizer state are replicated, pair activations are row-sharded via
+  the constraints in parallel/sharding.py — XLA inserts the psum for the
+  gradient all-reduce (the reference is strictly single-device, SURVEY.md
+  S2.3)
+- gradient accumulation uses optax.MultiSteps (single compiled step instead
+  of a python accumulation loop)
+- bfloat16 compute / float32 params + optimizer
+- failure handling the reference lacks (SURVEY.md S5.3): NaN/Inf gradients
+  are detected in-graph and the step is skipped (state update suppressed).
+"""
+
+from __future__ import annotations
+
+from contextlib import nullcontext
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from alphafold2_tpu.config import Config
+from alphafold2_tpu.models.alphafold2 import Alphafold2
+from alphafold2_tpu.parallel.sharding import DATA_AXIS, use_mesh
+from alphafold2_tpu.utils.structure import get_bucketed_distance_matrix
+
+
+class TrainState(train_state.TrainState):
+    """Adds a monotone count of skipped (non-finite-gradient) steps."""
+
+    skipped: jnp.ndarray = None  # scalar int32
+
+
+def distogram_cross_entropy(
+    logits: jnp.ndarray, labels: jnp.ndarray, ignore_index: int = -100
+) -> jnp.ndarray:
+    """Mean CE over non-ignored pairs (reference train_pre.py:84-87)."""
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+
+def build_model(cfg: Config) -> Alphafold2:
+    m = cfg.model
+    return Alphafold2(
+        dim=m.dim,
+        max_seq_len=m.max_seq_len,
+        depth=m.depth,
+        heads=m.heads,
+        dim_head=m.dim_head,
+        attn_dropout=m.attn_dropout,
+        ff_dropout=m.ff_dropout,
+        remat=m.remat,
+        sparse_self_attn=m.sparse_self_attn,
+        cross_attn_compress_ratio=m.cross_attn_compress_ratio,
+        msa_tie_row_attn=m.msa_tie_row_attn,
+        template_attn_depth=m.template_attn_depth,
+        dtype=jnp.bfloat16 if m.bfloat16 else jnp.float32,
+    )
+
+
+def build_optimizer(cfg: Config) -> optax.GradientTransformation:
+    t = cfg.train
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=t.learning_rate,
+        warmup_steps=t.warmup_steps,
+        decay_steps=max(t.num_steps, t.warmup_steps + 1),
+        end_value=t.learning_rate * 0.1,
+    )
+    tx = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(schedule, weight_decay=t.weight_decay),
+    )
+    if t.gradient_accumulate_every > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=t.gradient_accumulate_every)
+    return tx
+
+
+def init_state(cfg: Config, model: Alphafold2, sample_batch: dict) -> TrainState:
+    rng = jax.random.key(cfg.train.seed)
+    params = model.init(
+        rng,
+        jnp.asarray(sample_batch["seq"]),
+        jnp.asarray(sample_batch["msa"]),
+        mask=jnp.asarray(sample_batch["mask"]),
+        msa_mask=jnp.asarray(sample_batch["msa_mask"]),
+    )
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=params,
+        tx=build_optimizer(cfg),
+        skipped=jnp.zeros((), jnp.int32),
+    )
+
+
+def make_train_step(model: Alphafold2, mesh: Optional[Mesh] = None):
+    """Build the jitted distogram-pretraining step.
+
+    Returns step(state, batch, rng) -> (state, metrics). When a mesh is
+    given, inputs/outputs carry explicit shardings and the model's internal
+    sharding constraints are active.
+    """
+
+    def step(state: TrainState, batch: dict, rng: jax.Array):
+        ctx = use_mesh(mesh) if mesh is not None else nullcontext()
+        with ctx:
+            def loss_fn(params):
+                logits = model.apply(
+                    params,
+                    batch["seq"],
+                    batch["msa"],
+                    mask=batch["mask"],
+                    msa_mask=batch["msa_mask"],
+                    deterministic=False,
+                    rngs={"dropout": rng},
+                )
+                labels = get_bucketed_distance_matrix(
+                    batch["coords"], batch["mask"]
+                )
+                return distogram_cross_entropy(logits, labels), logits
+
+            (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params
+            )
+            # failure detection: skip the update on non-finite gradients
+            grads_ok = jnp.all(
+                jnp.asarray(
+                    [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]
+                )
+            )
+            safe_grads = jax.tree.map(
+                lambda g: jnp.where(grads_ok, g, jnp.zeros_like(g)), grads
+            )
+            new_state = state.apply_gradients(grads=safe_grads)
+            new_state = new_state.replace(
+                skipped=state.skipped + jnp.where(grads_ok, 0, 1)
+            )
+            gnorm = optax.global_norm(grads)
+            metrics = {
+                "loss": loss,
+                "grad_norm": gnorm,
+                "grads_ok": grads_ok,
+                "distogram_entropy": -jnp.mean(
+                    jnp.sum(
+                        jax.nn.softmax(logits, -1) * jax.nn.log_softmax(logits, -1),
+                        -1,
+                    )
+                ),
+            }
+            return new_state, metrics
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=0)
+
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.jit(
+        step,
+        in_shardings=(repl, data, repl),
+        out_shardings=(repl, repl),
+        donate_argnums=0,
+    )
+
+
+def device_put_batch(batch: dict, mesh: Optional[Mesh] = None) -> dict:
+    if mesh is None:
+        return {k: jnp.asarray(v) for k, v in batch.items()}
+    sh = NamedSharding(mesh, P(DATA_AXIS))
+    return {k: jax.device_put(jnp.asarray(v), sh) for k, v in batch.items()}
+
+
+def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=()):
+    """Distogram pretraining driver (the runnable train_pre.py equivalent)."""
+    import time
+
+    from alphafold2_tpu.data.pipeline import make_dataset
+    from alphafold2_tpu.parallel.sharding import make_mesh
+    from alphafold2_tpu.train.checkpoint import CheckpointManager
+    from alphafold2_tpu.train.observe import MetricsLogger, Profiler
+
+    num_steps = num_steps or cfg.train.num_steps
+    dataset = dataset or make_dataset(cfg.data, seed=cfg.train.seed)
+    data_iter = iter(dataset)
+
+    mesh = None
+    n_mesh = cfg.mesh.data_parallel * cfg.mesh.seq_parallel
+    if n_mesh > 1 or cfg.mesh.seq_parallel > 1:
+        mesh = make_mesh(cfg.mesh.data_parallel, cfg.mesh.seq_parallel)
+
+    model = build_model(cfg)
+    sample = next(data_iter)
+    state = init_state(cfg, model, sample)
+    step_fn = make_train_step(model, mesh)
+
+    ckpt = (
+        CheckpointManager(cfg.train.checkpoint_dir, keep=cfg.train.keep_checkpoints)
+        if cfg.train.checkpoint_dir
+        else None
+    )
+    start_step = 0
+    if ckpt is not None:
+        state, start_step = ckpt.maybe_restore(state)
+
+    logger = MetricsLogger(cfg.train.checkpoint_dir)
+    profiler = Profiler(cfg.train.profile_dir, cfg.train.profile_steps)
+    rng = jax.random.key(cfg.train.seed + 1)
+
+    batch = device_put_batch(sample, mesh)
+    t0 = time.perf_counter()
+    for i in range(start_step, num_steps):
+        profiler.maybe_start(i)
+        rng, step_rng = jax.random.split(rng)
+        state, metrics = step_fn(state, batch, step_rng)
+        profiler.maybe_stop(i)
+        if (i + 1) % cfg.train.log_every == 0 or i == 0:
+            m = {k: float(v) for k, v in metrics.items()}
+            m["steps_per_sec"] = (
+                cfg.train.log_every / (time.perf_counter() - t0) if i else 0.0
+            )
+            t0 = time.perf_counter()
+            logger.log(i, m)
+        for cb in callbacks:
+            cb(i, state, metrics)
+        if ckpt is not None and (i + 1) % cfg.train.checkpoint_every == 0:
+            ckpt.save(i + 1, state)
+        batch = device_put_batch(next(data_iter), mesh)
+    if ckpt is not None:
+        ckpt.save(num_steps, state)
+        ckpt.wait()
+    return state

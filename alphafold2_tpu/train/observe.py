@@ -1,0 +1,58 @@
+"""Observability: metric logging + jax.profiler trace hooks.
+
+The reference's only observability is ``print`` (train_pre.py:92,
+SURVEY.md S5.1/S5.5). Here: structured JSONL metrics (greppable, plottable)
+plus stdout, and a profiler that captures an XLA trace for a configured step
+window (``train.profile_dir`` / ``train.profile_steps``) viewable in
+TensorBoard/XProf — the first-class tracing subsystem SURVEY.md asks for.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional, Tuple
+
+
+class MetricsLogger:
+    def __init__(self, directory: Optional[str] = None, filename: str = "metrics.jsonl"):
+        self._path = None
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+            self._path = os.path.join(directory, filename)
+
+    def log(self, step: int, metrics: dict) -> None:
+        record = {"step": step, "time": time.time(), **metrics}
+        line = json.dumps(record)
+        print(f"[step {step}] " + " ".join(
+            f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in metrics.items()
+        ), flush=True)
+        if self._path:
+            with open(self._path, "a") as f:
+                f.write(line + "\n")
+
+
+class Profiler:
+    """Start/stop a jax profiler trace across a [start, stop) step window."""
+
+    def __init__(self, trace_dir: Optional[str], steps: Tuple[int, int] = (10, 13)):
+        self._dir = trace_dir
+        self._start, self._stop = steps
+        self._active = False
+
+    def maybe_start(self, step: int) -> None:
+        if self._dir and step == self._start and not self._active:
+            import jax
+
+            jax.profiler.start_trace(self._dir)
+            self._active = True
+
+    def maybe_stop(self, step: int) -> None:
+        if self._active and step >= self._stop:
+            import jax
+
+            jax.block_until_ready(jax.numpy.zeros(()))
+            jax.profiler.stop_trace()
+            self._active = False

@@ -1,0 +1,95 @@
+"""Benchmark: distogram-pretraining step throughput on the flagship config.
+
+Primary metric (BASELINE.md): residue-pairs/sec/chip at crop 256. The
+reference publishes no numbers (BASELINE.json "published": {}), so
+``vs_baseline`` is measured against the first recorded run of this bench
+(bench_baseline.json, committed after the first TPU run) — i.e. the
+framework competes against its own round-1 number.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+CROP = 256
+MSA_DEPTH = 16
+MSA_LEN = 256
+DIM = 256
+DEPTH = 2
+BATCH = 1
+WARMUP = 3
+ITERS = 10
+
+
+def main():
+    from alphafold2_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+    from alphafold2_tpu.data.pipeline import SyntheticDataset
+    from alphafold2_tpu.train.loop import (
+        build_model,
+        device_put_batch,
+        init_state,
+        make_train_step,
+    )
+
+    cfg = Config(
+        model=ModelConfig(
+            dim=DIM, depth=DEPTH, heads=8, dim_head=64, max_seq_len=CROP * 2,
+            msa_tie_row_attn=True, bfloat16=True,
+        ),
+        data=DataConfig(
+            crop_len=CROP, msa_depth=MSA_DEPTH, msa_len=MSA_LEN, batch_size=BATCH,
+            min_len_filter=CROP,  # full-length crops for a stable FLOP count
+        ),
+        train=TrainConfig(gradient_accumulate_every=1, warmup_steps=10),
+    )
+
+    batch = next(iter(SyntheticDataset(cfg.data, seed=0)))
+    model = build_model(cfg)
+    state = init_state(cfg, model, batch)
+    step = make_train_step(model, mesh=None)
+    dev_batch = device_put_batch(batch)
+    rng = jax.random.key(0)
+
+    for i in range(WARMUP):
+        rng, r = jax.random.split(rng)
+        state, metrics = step(state, dev_batch, r)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        rng, r = jax.random.split(rng)
+        state, metrics = step(state, dev_batch, r)
+    jax.block_until_ready(metrics["loss"])
+    dt = (time.perf_counter() - t0) / ITERS
+
+    pairs_per_sec = BATCH * CROP * CROP / dt
+
+    baseline_path = os.path.join(os.path.dirname(__file__), "bench_baseline.json")
+    vs_baseline = 1.0
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            base = json.load(f)
+        if base.get("value"):
+            vs_baseline = pairs_per_sec / base["value"]
+
+    print(
+        json.dumps(
+            {
+                "metric": f"residue-pairs/sec/chip crop={CROP} msa={MSA_DEPTH}x{MSA_LEN} dim={DIM} depth={DEPTH} fwd+bwd+opt",
+                "value": round(pairs_per_sec, 1),
+                "unit": "pairs/sec",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+#!/bin/bash
+# Canonical test invocation: hermetic CPU jax with 8 virtual devices.
+# PALLAS_AXON_POOL_IPS= disables the axon TPU relay hook in sitecustomize
+# (it serializes every jax process through a single tunnel — tests must not
+# touch it). See tests/conftest.py for the in-process fallback.
+exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -m pytest "${@:-tests/}" -q

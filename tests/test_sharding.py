@@ -1,0 +1,74 @@
+"""Multi-device tests on the virtual 8-CPU mesh: the sharded train step
+compiles + executes, produces the same numbers as single-device, and the
+dryrun entry point works. The reference has no distributed tests at all
+(SURVEY.md S4) — this is the fake-backend tier it lacked."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.config import Config, DataConfig, MeshConfig, ModelConfig, TrainConfig
+from alphafold2_tpu.data.pipeline import SyntheticDataset
+from alphafold2_tpu.parallel.sharding import make_mesh
+from alphafold2_tpu.train.loop import (
+    build_model,
+    device_put_batch,
+    init_state,
+    make_train_step,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def _cfg(batch_size=4):
+    return Config(
+        model=ModelConfig(dim=32, depth=1, heads=2, dim_head=16, max_seq_len=64,
+                          bfloat16=False),
+        data=DataConfig(crop_len=16, msa_depth=2, msa_len=16,
+                        batch_size=batch_size, min_len_filter=8),
+        train=TrainConfig(gradient_accumulate_every=1, warmup_steps=2),
+    )
+
+
+def test_dp_sp_step_matches_single_device():
+    cfg = _cfg(batch_size=4)
+    batch = next(iter(SyntheticDataset(cfg.data, seed=0)))
+    model = build_model(cfg)
+    state = init_state(cfg, model, batch)
+
+    # single device
+    step1 = make_train_step(model, mesh=None)
+    s1, m1 = step1(state, device_put_batch(batch), jax.random.key(7))
+
+    # 4dp x 2sp mesh
+    state2 = init_state(cfg, model, batch)
+    mesh = make_mesh(4, 2)
+    step2 = make_train_step(model, mesh=mesh)
+    s2, m2 = step2(state2, device_put_batch(batch, mesh), jax.random.key(7))
+
+    assert np.isclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-4), (
+        float(m1["loss"]), float(m2["loss"]),
+    )
+    # updated params agree across the two layouts
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        assert np.allclose(a, b, atol=1e-4)
+
+
+def test_sp_only_mesh():
+    cfg = _cfg(batch_size=1)
+    batch = next(iter(SyntheticDataset(cfg.data, seed=1)))
+    model = build_model(cfg)
+    state = init_state(cfg, model, batch)
+    mesh = make_mesh(1, 8)
+    step = make_train_step(model, mesh=mesh)
+    state, metrics = step(state, device_put_batch(batch, mesh), jax.random.key(0))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_graft_dryrun_multichip():
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)
