@@ -112,6 +112,8 @@ class Alphafold2(nn.Module):
     ff_dropout: float = 0.0
     remat: bool = False
     sparse_self_attn: tuple | bool = False
+    sparse_config: Optional[object] = None  # ops.sparse.BlockSparseConfig
+    sparse_use_pallas: Optional[bool] = None  # None -> Pallas kernel on TPU
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
     template_attn_depth: int = 2
@@ -242,6 +244,8 @@ class Alphafold2(nn.Module):
             ff_dropout=self.ff_dropout,
             sparse_self_attn=self.sparse_self_attn,
             seq_len=self.max_seq_len,
+            sparse_config=self.sparse_config,
+            sparse_use_pallas=self.sparse_use_pallas,
             cross_attn_compress_ratio=self.cross_attn_compress_ratio,
             msa_tie_row_attn=self.msa_tie_row_attn,
             remat=self.remat,

@@ -43,6 +43,8 @@ class TrunkLayer(nn.Module):
     ff_dropout: float = 0.0
     sparse_attn: bool = False
     seq_len: Optional[int] = None
+    sparse_config: Optional[object] = None  # ops.sparse.BlockSparseConfig
+    sparse_use_pallas: Optional[bool] = None
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
     dtype: jnp.dtype = jnp.float32
@@ -67,6 +69,8 @@ class TrunkLayer(nn.Module):
             dropout=self.attn_dropout,
             sparse_attn=self.sparse_attn,
             seq_len=self.seq_len,
+            sparse_config=self.sparse_config,
+            sparse_use_pallas=self.sparse_use_pallas,
             dtype=dt,
             name="pair_axial",
         )(ln("pair_axial_norm")(x), mask=pair_mask, deterministic=deterministic)
@@ -155,6 +159,8 @@ class Trunk(nn.Module):
     ff_dropout: float = 0.0
     sparse_self_attn: tuple | bool = False
     seq_len: Optional[int] = None
+    sparse_config: Optional[object] = None  # ops.sparse.BlockSparseConfig
+    sparse_use_pallas: Optional[bool] = None
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
     remat: bool = False
@@ -182,6 +188,8 @@ class Trunk(nn.Module):
                 ff_dropout=self.ff_dropout,
                 sparse_attn=sparse,
                 seq_len=self.seq_len,
+                sparse_config=self.sparse_config,
+                sparse_use_pallas=self.sparse_use_pallas,
                 cross_attn_compress_ratio=self.cross_attn_compress_ratio,
                 msa_tie_row_attn=self.msa_tie_row_attn,
                 dtype=self.dtype,

@@ -188,11 +188,13 @@ class AxialAttention(nn.Module):
     tie_row_attn: bool = False
     sparse_attn: bool = False
     seq_len: Optional[int] = None  # static max length for sparse block layout
+    sparse_config: Optional[object] = None  # ops.sparse.BlockSparseConfig
+    sparse_use_pallas: Optional[bool] = None  # None -> auto (Pallas on TPU)
     dtype: jnp.dtype = jnp.float32
 
     def _attn_cls(self, name):
         if self.sparse_attn:
-            from alphafold2_tpu.ops.sparse import SparseAttention
+            from alphafold2_tpu.ops.sparse import BlockSparseConfig, SparseAttention
 
             return SparseAttention(
                 dim=self.dim,
@@ -200,6 +202,8 @@ class AxialAttention(nn.Module):
                 dim_head=self.dim_head,
                 dropout=self.dropout,
                 seq_len=self.seq_len,
+                config=self.sparse_config or BlockSparseConfig(),
+                use_pallas=self.sparse_use_pallas,
                 dtype=self.dtype,
                 name=name,
             )

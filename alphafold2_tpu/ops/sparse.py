@@ -1,0 +1,229 @@
+"""Block-sparse attention: sparsity config, layout, gather-based jnp impl.
+
+Replaces the reference's DeepSpeed ``SparseSelfAttention`` +
+``VariableSparsityConfig`` Triton/CUDA path (reference alphafold2.py:184-239;
+built by install_deepspeed.sh) with a TPU-native design:
+
+- :class:`BlockSparseConfig` — variable sparsity layout abstraction: local
+  sliding-window blocks, global blocks (first rows+columns dense), and
+  seeded random blocks per row — the same layout family as DeepSpeed's
+  VariableSparsityConfig (block=16, num_random_blocks=seq_len/block/4 default,
+  bidirectional; reference alphafold2.py:198-206).
+- :func:`block_sparse_attention` — gather-based jnp implementation: for each
+  query block, gather its active KV blocks (static layout -> static gather
+  indices baked at trace time) and attend only over those. Compute is
+  O(N * active_blocks * block) rather than O(N^2); runs on any backend and
+  is the oracle for the Pallas kernel.
+- :class:`SparseAttention` — drop-in module matching :class:`Attention`'s
+  call surface for the self-attention case (the reference's sparse path is
+  self-attn only and incompatible with tied rows, alphafold2.py:193).
+- the Pallas TPU kernel lives in ops/pallas/block_sparse.py; it is selected
+  with ``use_pallas=True`` (or on TPU backends) and validated against the
+  jnp implementation — including the dense-layout == dense-attention
+  differential test (tests/test_sparse.py).
+
+Unlike the reference, a caller-supplied mask composes with padding instead of
+being overwritten (alphafold2.py:222 clobbers it — SURVEY.md S2.5), and
+there is no dead dense-dots compute (alphafold2.py:228).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from alphafold2_tpu.ops.attention import MASK_VALUE
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockSparseConfig:
+    """Variable block-sparsity layout (bidirectional).
+
+    block_size: attention block edge (reference default 16; use 128 on TPU
+    for lane alignment). num_local_blocks: sliding window width in blocks.
+    num_global_blocks: leading blocks attending/attended densely.
+    num_random_blocks: extra random blocks per query row; None -> the
+    reference's default seq_len/block/4 (alphafold2.py:198).
+    """
+
+    block_size: int = 16
+    num_local_blocks: int = 4
+    num_global_blocks: int = 1
+    num_random_blocks: Optional[int] = None
+    seed: int = 0
+
+    def resolve_random(self, seq_len: int) -> int:
+        if self.num_random_blocks is not None:
+            return self.num_random_blocks
+        return max(seq_len // self.block_size // 4, 0)
+
+    def layout(self, seq_len: int) -> np.ndarray:
+        """(num_blocks, num_blocks) bool — True where a block attends."""
+        assert seq_len % self.block_size == 0, (seq_len, self.block_size)
+        nb = seq_len // self.block_size
+        lay = np.zeros((nb, nb), dtype=bool)
+        # local sliding window
+        half = self.num_local_blocks // 2
+        for i in range(nb):
+            lo = max(0, i - half)
+            hi = min(nb, i + max(self.num_local_blocks - half, 1))
+            lay[i, lo:hi] = True
+        # global blocks: first G rows and columns fully dense
+        g = min(self.num_global_blocks, nb)
+        lay[:g, :] = True
+        lay[:, :g] = True
+        # seeded random blocks per row
+        r = min(self.resolve_random(seq_len), nb)
+        if r > 0:
+            rng = np.random.default_rng(self.seed)
+            for i in range(nb):
+                lay[i, rng.choice(nb, size=r, replace=False)] = True
+        return lay
+
+
+def active_indices(layout: np.ndarray) -> tuple[np.ndarray, np.ndarray, int]:
+    """Pack the layout into per-row active-block index lists.
+
+    Returns (indices (nb, max_active) int32, valid (nb, max_active) bool,
+    max_active). Rows with fewer active blocks are padded with index 0 and
+    valid=False — static shapes for the gather.
+    """
+    nb = layout.shape[0]
+    counts = layout.sum(-1)
+    max_active = int(counts.max()) if nb else 0
+    idx = np.zeros((nb, max_active), dtype=np.int32)
+    valid = np.zeros((nb, max_active), dtype=bool)
+    for i in range(nb):
+        a = np.nonzero(layout[i])[0]
+        idx[i, : len(a)] = a
+        valid[i, : len(a)] = True
+    return idx, valid, max_active
+
+
+def block_sparse_attention(
+    q: jnp.ndarray,  # (B, H, N, D)
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    layout: np.ndarray,  # (nb, nb) bool, static
+    block_size: int,
+    mask: Optional[jnp.ndarray] = None,  # (B, N) bool key-side padding mask
+) -> jnp.ndarray:
+    """Gather-based block-sparse attention, numerically == dense attention
+    restricted to the layout's blocks. Scale is applied inside."""
+    b, h, n, d = q.shape
+    nb = n // block_size
+    idx, valid, max_active = active_indices(layout)
+    idx_j = jnp.asarray(idx)  # (nb, A)
+    valid_j = jnp.asarray(valid)
+
+    scale = d**-0.5
+    qb = q.reshape(b, h, nb, block_size, d)
+    kb = k.reshape(b, h, nb, block_size, d)
+    vb = v.reshape(b, h, nb, block_size, d)
+
+    # gather active KV blocks per query block: (B, H, nb, A, block, d)
+    kg = jnp.take(kb, idx_j.reshape(-1), axis=2).reshape(
+        b, h, nb, max_active, block_size, d
+    )
+    vg = jnp.take(vb, idx_j.reshape(-1), axis=2).reshape(
+        b, h, nb, max_active, block_size, d
+    )
+
+    dots = jnp.einsum("bhnqd,bhnakd->bhnqak", qb, kg) * scale
+
+    # mask: invalid (padding) active slots + key padding mask
+    am = valid_j[None, None, :, None, :, None]
+    if mask is not None:
+        mb = mask.reshape(b, nb, block_size)  # (B, nb, block)
+        mg = jnp.take(mb, idx_j.reshape(-1), axis=1).reshape(
+            b, nb, max_active, block_size
+        )
+        am = am & mg[:, None, :, None, :, :]
+    dots = jnp.where(am, dots, MASK_VALUE)
+
+    flat = dots.reshape(b, h, nb, block_size, max_active * block_size)
+    attn = jax.nn.softmax(flat.astype(jnp.float32), axis=-1).astype(q.dtype)
+    attn = attn.reshape(b, h, nb, block_size, max_active, block_size)
+    out = jnp.einsum("bhnqak,bhnakd->bhnqd", attn, vg)
+    return out.reshape(b, h, n, d)
+
+
+class SparseAttention(nn.Module):
+    """Block-sparse multi-head self-attention (drop-in for Attention).
+
+    Pads the sequence to a block multiple (composing with, not clobbering,
+    any caller mask) and slices the padding back off. ``seq_len`` bounds the
+    allowed input length (reference alphafold2.py:194,215).
+    """
+
+    dim: int
+    heads: int = 8
+    dim_head: int = 64
+    dropout: float = 0.0
+    seq_len: Optional[int] = None
+    config: BlockSparseConfig = BlockSparseConfig()
+    use_pallas: Optional[bool] = None  # None -> Pallas kernel on TPU backends
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x,
+        context=None,
+        mask=None,
+        context_mask=None,
+        tie_dim=None,
+        deterministic: bool = True,
+    ):
+        assert context is None, "sparse attention is self-attention only"
+        assert tie_dim is None, (
+            "sparse attention is not compatible with tying of row attention"
+        )
+        b, n, _ = x.shape
+        if self.seq_len is not None:
+            assert n <= self.seq_len, (
+                f"sequence length {n} exceeds max_seq_len {self.seq_len}"
+            )
+        h, dh = self.heads, self.dim_head
+        inner = h * dh
+        bs = self.config.block_size
+        pad = (-n) % bs
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        padded_n = n + pad
+        if mask is None:
+            mask = jnp.ones((b, n), dtype=bool)
+        if pad:
+            mask = jnp.pad(mask, ((0, 0), (0, pad)))
+
+        q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
+        kv = nn.Dense(inner * 2, use_bias=False, dtype=self.dtype, name="to_kv")(x)
+        k, v = jnp.split(kv, 2, axis=-1)
+
+        def heads_first(t):
+            return jnp.moveaxis(t.reshape(b, padded_n, h, dh), 2, 1)
+
+        q, k, v = heads_first(q), heads_first(k), heads_first(v)
+        layout = self.config.layout(padded_n)
+
+        use_pallas = self.use_pallas
+        if use_pallas is None:
+            use_pallas = jax.default_backend() == "tpu"
+        if use_pallas:
+            from alphafold2_tpu.ops.pallas.block_sparse import (
+                pallas_block_sparse_attention,
+            )
+
+            out = pallas_block_sparse_attention(q, k, v, layout, bs, mask=mask)
+        else:
+            out = block_sparse_attention(q, k, v, layout, bs, mask=mask)
+
+        out = jnp.moveaxis(out, 1, 2).reshape(b, padded_n, inner)
+        out = nn.Dense(self.dim, dtype=self.dtype, name="to_out")(out)
+        out = nn.Dropout(self.dropout)(out, deterministic=deterministic)
+        return out[:, :n]

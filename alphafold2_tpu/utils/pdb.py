@@ -1,0 +1,270 @@
+"""PDB I/O: pure-python parse / write / clean / coordinate-export.
+
+Replaces the reference's mdtraj+curl path (reference utils.py:92-158:
+``download_pdb`` shells out to curl, ``clean_pdb`` selects chains via mdtraj
+topology, ``custom2pdb`` rewrites a downloaded scaffold's coordinates).
+Host-side I/O has no TPU perf constraint (SURVEY.md S2.4), so this is a
+dependency-free implementation:
+
+- :class:`PDBStructure` — columnar atom records (numpy arrays), the unit all
+  functions operate on. Columnar beats an object-per-atom topology here: the
+  common operations (chain select, CA extraction, coordinate replacement) are
+  boolean-mask one-liners, and coords land directly in the (N, 3) float32
+  layout the jnp structure math consumes.
+- :func:`parse_pdb` / :func:`to_pdb_string` — fixed-column ATOM/HETATM record
+  codec (PDB format v3.3).
+- :func:`clean_pdb` — keep protein ATOM records, optionally one chain
+  (reference utils.py:103-129).
+- :func:`download_pdb` — RCSB fetch via urllib (reference utils.py:92-101);
+  network-gated with a clear error in hermetic environments.
+- :func:`custom2pdb` — model coords -> .pdb via a scaffold whose coordinates
+  are replaced in file order (reference utils.py:131-158), taking an optional
+  local scaffold path instead of forcing a download.
+- :func:`backbone_to_pdb` — scaffold-free export: build a PDB directly from a
+  predicted (L, 3, 3) N/CA/C backbone (or (L, 3) CA trace) + sequence, which
+  the reference cannot do at all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from alphafold2_tpu import constants
+
+THREE_TO_ONE = {
+    "ALA": "A", "CYS": "C", "ASP": "D", "GLU": "E", "PHE": "F",
+    "GLY": "G", "HIS": "H", "ILE": "I", "LYS": "K", "LEU": "L",
+    "MET": "M", "ASN": "N", "PRO": "P", "GLN": "Q", "ARG": "R",
+    "SER": "S", "THR": "T", "VAL": "V", "TRP": "W", "TYR": "Y",
+    # common non-standard residues mapped to their parent
+    "MSE": "M", "SEC": "C", "PYL": "K",
+}
+ONE_TO_THREE = {v: k for k, v in reversed(list(THREE_TO_ONE.items()))}
+
+
+@dataclasses.dataclass
+class PDBStructure:
+    """Columnar ATOM/HETATM records of one model."""
+
+    serial: np.ndarray  # (N,) int32
+    name: np.ndarray  # (N,) <U4 atom name, e.g. "CA"
+    resname: np.ndarray  # (N,) <U3
+    chain: np.ndarray  # (N,) <U1
+    resseq: np.ndarray  # (N,) int32
+    coords: np.ndarray  # (N, 3) float32 Angstroms
+    element: np.ndarray  # (N,) <U2
+    hetero: np.ndarray  # (N,) bool — HETATM record
+
+    def __len__(self) -> int:
+        return len(self.serial)
+
+    def select(self, mask: np.ndarray) -> "PDBStructure":
+        return PDBStructure(
+            self.serial[mask], self.name[mask], self.resname[mask],
+            self.chain[mask], self.resseq[mask], self.coords[mask],
+            self.element[mask], self.hetero[mask],
+        )
+
+    def chains(self) -> list[str]:
+        seen: dict[str, None] = {}
+        for c in self.chain:
+            seen.setdefault(str(c), None)
+        return list(seen)
+
+    def ca_trace(self) -> tuple[str, np.ndarray]:
+        """(sequence, (L, 3) CA coords) over protein residues, file order."""
+        mask = (self.name == "CA") & ~self.hetero
+        sub = self.select(mask)
+        seq = "".join(THREE_TO_ONE.get(str(r), "X") for r in sub.resname)
+        return seq, sub.coords.copy()
+
+
+def parse_pdb(text: str) -> PDBStructure:
+    """Parse ATOM/HETATM records (first MODEL only) from PDB-format text."""
+    serial, name, resname, chain, resseq = [], [], [], [], []
+    coords, element, hetero = [], [], []
+    for line in text.splitlines():
+        rec = line[:6]
+        if rec == "ENDMDL":  # first model only, like mdtraj's default frame
+            break
+        if rec not in ("ATOM  ", "HETATM"):
+            continue
+        # altloc: keep blank or 'A' only
+        if line[16] not in (" ", "A"):
+            continue
+        serial.append(int(line[6:11]))
+        name.append(line[12:16].strip())
+        resname.append(line[17:20].strip())
+        chain.append(line[21])
+        resseq.append(int(line[22:26]))
+        coords.append(
+            (float(line[30:38]), float(line[38:46]), float(line[46:54]))
+        )
+        element.append(line[76:78].strip() if len(line) >= 78 else "")
+        hetero.append(rec == "HETATM")
+    return PDBStructure(
+        np.asarray(serial, np.int32), np.asarray(name, "<U4"),
+        np.asarray(resname, "<U3"), np.asarray(chain, "<U1"),
+        np.asarray(resseq, np.int32),
+        np.asarray(coords, np.float32).reshape(-1, 3),
+        np.asarray(element, "<U2"), np.asarray(hetero, bool),
+    )
+
+
+def load_pdb(path: str) -> PDBStructure:
+    with open(path) as f:
+        return parse_pdb(f.read())
+
+
+def to_pdb_string(s: PDBStructure) -> str:
+    """Serialize to fixed-column PDB v3.3 ATOM/HETATM records + TER/END."""
+    lines = []
+    prev_chain = None
+    for i in range(len(s)):
+        if prev_chain is not None and s.chain[i] != prev_chain:
+            lines.append("TER")
+        prev_chain = s.chain[i]
+        rec = "HETATM" if s.hetero[i] else "ATOM  "
+        nm = str(s.name[i])
+        # PDB atom-name column quirk: 1-letter elements start at col 14
+        nm = f" {nm:<3}" if len(nm) < 4 and len(str(s.element[i])) < 2 else f"{nm:<4}"
+        x, y, z = (float(v) for v in s.coords[i])
+        lines.append(
+            f"{rec}{int(s.serial[i]):5d} {nm} {str(s.resname[i]):>3}"
+            f" {str(s.chain[i])}{int(s.resseq[i]):4d}    "
+            f"{x:8.3f}{y:8.3f}{z:8.3f}{1.0:6.2f}{0.0:6.2f}"
+            f"          {str(s.element[i]):>2}"
+        )
+    lines.append("TER")
+    lines.append("END")
+    return "\n".join(lines) + "\n"
+
+
+def save_pdb(s: PDBStructure, path: str) -> str:
+    with open(path, "w") as f:
+        f.write(to_pdb_string(s))
+    return path
+
+
+def download_pdb(name: str, route: str, timeout: float = 30.0) -> str:
+    """Fetch an RCSB entry (reference utils.py:92-101 shells out to curl).
+
+    Raises a clear RuntimeError in hermetic (no-egress) environments instead
+    of silently writing an empty file like ``curl > route`` does.
+    """
+    import urllib.error
+    import urllib.request
+
+    url = f"https://files.rcsb.org/download/{name}.pdb"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            data = resp.read()
+    except (urllib.error.URLError, OSError) as e:
+        raise RuntimeError(
+            f"cannot download {url!r} (no network access?): {e}"
+        ) from e
+    with open(route, "wb") as f:
+        f.write(data)
+    return route
+
+
+def clean_pdb(
+    name: str,
+    route: Optional[str] = None,
+    chain_id: Optional[str] = None,
+    chain_num: Optional[int] = None,
+) -> str:
+    """Keep protein ATOM records, optionally a single chain; write back.
+
+    Mirrors reference utils.py:103-129 (mdtraj chain selection) with the same
+    overwrite-input default. ``chain_num`` is the 0-based chain index in file
+    order (the reference compares against mdtraj's ``chain.index``);
+    ``chain_id`` selects by letter.
+    """
+    destin = route if route is not None else name
+    s = load_pdb(name)
+    keep = ~s.hetero & np.isin(s.resname, list(THREE_TO_ONE))
+    if chain_id is not None:
+        keep &= s.chain == chain_id
+    elif chain_num is not None:
+        keep &= s.chain == s.chains()[chain_num]
+    return save_pdb(s.select(keep), destin)
+
+
+def replace_coords(s: PDBStructure, coords: np.ndarray) -> PDBStructure:
+    """New structure with coordinates replaced in file order (scaffold trick,
+    reference utils.py:152-157)."""
+    coords = np.asarray(coords, np.float32)
+    if coords.shape[0] == 3 and coords.shape[-1] != 3:
+        coords = coords.T
+    assert coords.shape == s.coords.shape, (coords.shape, s.coords.shape)
+    return dataclasses.replace(s, coords=coords)
+
+
+def custom2pdb(
+    coords,
+    proteinnet_id: str,
+    route: str,
+    scaffold_path: Optional[str] = None,
+) -> tuple[str, str]:
+    """Model coords -> .pdb via a scaffold structure (reference utils.py:131-158).
+
+    proteinnet_id: ``<class>#<pdb_id>_<chain_number>_<chain_id>``. When
+    ``scaffold_path`` is given the download step is skipped (the reference
+    always re-downloads); coordinates are replaced in file order.
+    """
+    coords = np.asarray(coords, np.float32)
+    tokens = proteinnet_id.split("#")[-1].split("_")
+    pdb_name, chain_num = tokens[0], tokens[1]
+    if scaffold_path is None:
+        scaffold_path = os.path.join(os.path.dirname(route) or ".", pdb_name + ".pdb")
+        download_pdb(pdb_name, scaffold_path)
+        clean_pdb(scaffold_path, chain_num=int(chain_num))
+    scaffold = load_pdb(scaffold_path)
+    save_pdb(replace_coords(scaffold, coords), route)
+    return scaffold_path, route
+
+
+def backbone_to_pdb(
+    seq: Sequence[int] | str,
+    backbone: np.ndarray,
+    chain: str = "A",
+) -> PDBStructure:
+    """Build a structure from predicted coords — no scaffold needed.
+
+    seq: length-L string or int indices (AA_ALPHABET order). backbone:
+    (L, 3, 3) N/CA/C per residue, or (L, 3) CA-only. This is the natural
+    export for the end-to-end pipeline's MDS/refined output
+    (train/end2end.py), which the reference could only write through a
+    downloaded scaffold of the *true* structure.
+    """
+    backbone = np.asarray(backbone, np.float32)
+    if isinstance(seq, str):
+        letters = list(seq)
+    else:
+        letters = [
+            constants.AA_ALPHABET[int(i)] if int(i) < 20 else "X" for i in seq
+        ]
+    L = len(letters)
+    ca_only = backbone.ndim == 2
+    names = ["CA"] if ca_only else ["N", "CA", "C"]
+    per = len(names)
+    assert backbone.size == L * per * 3, (backbone.shape, L, per)
+    coords = backbone.reshape(L * per, 3)
+    n = L * per
+    return PDBStructure(
+        serial=np.arange(1, n + 1, dtype=np.int32),
+        name=np.asarray(names * L, "<U4"),
+        resname=np.asarray(
+            [ONE_TO_THREE.get(a, "UNK") for a in letters for _ in names], "<U3"
+        ),
+        chain=np.full(n, chain, "<U1"),
+        resseq=np.repeat(np.arange(1, L + 1, dtype=np.int32), per),
+        coords=coords,
+        element=np.asarray([nm[0] for nm in names] * L, "<U2"),
+        hetero=np.zeros(n, bool),
+    )

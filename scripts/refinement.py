@@ -1,0 +1,86 @@
+"""Structure refinement via PyRosetta FastRelax — optional-dependency stub.
+
+Keeps the same optional-stub shape as the reference (scripts/refinement.py:
+import is warning-guarded :8-14, pdb<->pose conversion :22-54, and
+``run_fast_relax`` loads a JSON config then raises NotImplementedError
+:56-74). PyRosetta is licensed/closed and out of scope (SURVEY.md S2.4);
+what IS implemented here is everything around the rosetta call so a user
+with PyRosetta installed only fills in the marked section.
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+from pathlib import Path
+
+try:
+    import pyrosetta  # type: ignore
+
+    HAS_PYROSETTA = True
+    pyrosetta.init(silent=True)
+except ImportError:
+    HAS_PYROSETTA = False
+    warnings.warn(
+        "pyrosetta not installed: FastRelax refinement unavailable. "
+        "Install from https://www.pyrosetta.org/ (license required)."
+    )
+
+DEFAULT_CONFIG = {
+    "scorefxn": "ref2015",
+    "max_iter": 100,
+    "constrain_relax_to_start_coords": True,
+}
+
+
+def pdb_to_pose(path: str):
+    """Load a .pdb into a rosetta Pose (reference scripts/refinement.py:22-37)."""
+    if not HAS_PYROSETTA:
+        raise ImportError("pyrosetta required for pdb_to_pose")
+    return pyrosetta.pose_from_pdb(path)
+
+
+def pose_to_pdb(pose, path: str) -> str:
+    """Write a rosetta Pose to .pdb (reference scripts/refinement.py:39-54)."""
+    if not HAS_PYROSETTA:
+        raise ImportError("pyrosetta required for pose_to_pdb")
+    pose.dump_pdb(path)
+    return path
+
+
+def load_config(path: str | None = None) -> dict:
+    cfg = dict(DEFAULT_CONFIG)
+    if path is not None:
+        cfg.update(json.loads(Path(path).read_text()))
+    return cfg
+
+
+def run_fast_relax(pdb_in: str, pdb_out: str, config_path: str | None = None) -> str:
+    """FastRelax a structure (reference scripts/refinement.py:56-74 raises
+    NotImplementedError after loading its config; same contract here when
+    pyrosetta is absent)."""
+    config = load_config(config_path)
+    if not HAS_PYROSETTA:
+        raise NotImplementedError(
+            f"FastRelax needs pyrosetta (config loaded: {config})"
+        )
+    pose = pdb_to_pose(pdb_in)
+    scorefxn = pyrosetta.create_score_function(config["scorefxn"])
+    relax = pyrosetta.rosetta.protocols.relax.FastRelax(scorefxn)
+    relax.max_iter(int(config["max_iter"]))
+    relax.constrain_relax_to_start_coords(
+        bool(config["constrain_relax_to_start_coords"])
+    )
+    relax.apply(pose)
+    return pose_to_pdb(pose, pdb_out)
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("pdb_in")
+    ap.add_argument("pdb_out")
+    ap.add_argument("--config", default=None)
+    args = ap.parse_args()
+    run_fast_relax(args.pdb_in, args.pdb_out, config_path=args.config)

@@ -10,12 +10,21 @@ run the suite on real accelerators instead.
 import os
 
 if not os.environ.get("AF2TPU_TEST_TPU"):
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
+
+    # Site hooks (e.g. a PJRT plugin registered via sitecustomize) may set
+    # jax.config.jax_platforms programmatically at interpreter start, which
+    # takes precedence over the env var and would point every test at the
+    # accelerator tunnel. Force the config back to CPU before any backend
+    # initializes.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import sys
 

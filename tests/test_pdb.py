@@ -1,0 +1,102 @@
+"""PDB I/O tests: codec round-trip, chain cleaning, scaffold coordinate
+replacement (the custom2pdb path, reference utils.py:131-158), and the
+scaffold-free backbone export."""
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu.utils import pdb
+
+
+def _fixture_structure():
+    # two chains; chain B has a HETATM water
+    bb = np.asarray(
+        [
+            [[0.0, 0, 0], [1.46, 0, 0], [2.4, 1.1, 0]],
+            [[3.8, 1.2, 0.4], [5.2, 1.3, 0.5], [6.1, 2.4, 0.6]],
+        ],
+        np.float32,
+    )
+    s = pdb.backbone_to_pdb("AG", bb, chain="A")
+    w = pdb.PDBStructure(
+        serial=np.asarray([99], np.int32),
+        name=np.asarray(["O"], "<U4"),
+        resname=np.asarray(["HOH"], "<U3"),
+        chain=np.asarray(["B"], "<U1"),
+        resseq=np.asarray([1], np.int32),
+        coords=np.asarray([[9.0, 9.0, 9.0]], np.float32),
+        element=np.asarray(["O"], "<U2"),
+        hetero=np.asarray([True]),
+    )
+    return pdb.PDBStructure(
+        *(
+            np.concatenate([getattr(s, f.name), getattr(w, f.name)])
+            for f in s.__dataclass_fields__.values()
+        )
+    )
+
+
+def test_roundtrip_parse_write():
+    s = _fixture_structure()
+    text = pdb.to_pdb_string(s)
+    p = pdb.parse_pdb(text)
+    assert len(p) == len(s)
+    assert list(p.name) == list(s.name)
+    assert list(p.resname) == list(s.resname)
+    assert np.allclose(p.coords, s.coords, atol=1e-3)  # 3-decimal PDB cols
+    assert p.hetero[-1] and not p.hetero[0]
+
+
+def test_ca_trace_and_chains():
+    s = _fixture_structure()
+    assert s.chains() == ["A", "B"]
+    seq, ca = s.ca_trace()
+    assert seq == "AG"
+    assert ca.shape == (2, 3)
+    assert np.allclose(ca[0], [1.46, 0, 0], atol=1e-3)
+
+
+def test_clean_pdb_selects_chain(tmp_path):
+    s = _fixture_structure()
+    src = str(tmp_path / "in.pdb")
+    pdb.save_pdb(s, src)
+    out = pdb.clean_pdb(src, route=str(tmp_path / "out.pdb"), chain_id="A")
+    cleaned = pdb.load_pdb(out)
+    assert cleaned.chains() == ["A"]
+    assert not cleaned.hetero.any()
+    # chain_num path (0-based file order) picks the same chain
+    out2 = pdb.clean_pdb(src, route=str(tmp_path / "out2.pdb"), chain_num=0)
+    assert pdb.load_pdb(out2).chains() == ["A"]
+
+
+def test_custom2pdb_with_local_scaffold(tmp_path):
+    s = _fixture_structure()
+    scaffold = str(tmp_path / "scaffold.pdb")
+    pdb.clean_pdb(pdb.save_pdb(s, scaffold))
+    n = len(pdb.load_pdb(scaffold))
+    new_coords = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+    _, route = pdb.custom2pdb(
+        new_coords, "x#1ABC_0_A", str(tmp_path / "out.pdb"),
+        scaffold_path=scaffold,
+    )
+    got = pdb.load_pdb(route)
+    assert np.allclose(got.coords, new_coords, atol=1e-3)
+    # (3, N) transposed input accepted like the reference
+    _, route2 = pdb.custom2pdb(
+        new_coords.T, "x#1ABC_0_A", str(tmp_path / "out2.pdb"),
+        scaffold_path=scaffold,
+    )
+    assert np.allclose(pdb.load_pdb(route2).coords, new_coords, atol=1e-3)
+
+
+def test_download_gated():
+    with pytest.raises(RuntimeError, match="download"):
+        pdb.download_pdb("1ABC", "/tmp/should_not_exist.pdb", timeout=0.2)
+
+
+def test_backbone_to_pdb_ca_only():
+    ca = np.random.default_rng(0).normal(size=(5, 3)).astype(np.float32)
+    s = pdb.backbone_to_pdb([0, 1, 2, 3, 4], ca)
+    assert len(s) == 5
+    assert set(s.name) == {"CA"}
+    assert pdb.parse_pdb(pdb.to_pdb_string(s)).resseq[-1] == 5

@@ -1,0 +1,150 @@
+"""Block-sparse attention tests: the dense-layout differential oracle
+(sparse with all-blocks-active == dense attention — the correctness bar
+SURVEY.md S7 sets for the kernel), jnp-vs-Pallas parity, layout properties,
+and the module-level padding/mask behavior the reference got wrong."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.ops.attention import Attention
+from alphafold2_tpu.ops.sparse import (
+    BlockSparseConfig,
+    SparseAttention,
+    active_indices,
+    block_sparse_attention,
+)
+
+
+def _qkv(key, b=2, h=2, n=64, d=16):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (b, h, n, d)) for k in ks)
+
+
+def _dense_reference(q, k, v, mask=None):
+    d = q.shape[-1]
+    dots = jnp.einsum("bhid,bhjd->bhij", q, k) * d**-0.5
+    if mask is not None:
+        dots = jnp.where(mask[:, None, None, :], dots, -1e9)
+    attn = jax.nn.softmax(dots, axis=-1)
+    return jnp.einsum("bhij,bhjd->bhid", attn, v)
+
+
+def test_layout_properties():
+    cfg = BlockSparseConfig(block_size=16, num_local_blocks=4,
+                            num_global_blocks=1, num_random_blocks=2)
+    lay = cfg.layout(160)
+    nb = 10
+    assert lay.shape == (nb, nb)
+    assert lay[:1].all() and lay[:, :1].all()  # global row+col
+    assert all(lay[i, i] for i in range(nb))  # local window covers diagonal
+    # reference default: num_random = seq_len/block/4 (alphafold2.py:198)
+    assert BlockSparseConfig(block_size=16).resolve_random(2048) == 32
+
+
+def test_dense_layout_equals_dense_attention():
+    q, k, v = _qkv(jax.random.key(0))
+    layout = np.ones((4, 4), dtype=bool)  # 64/16 blocks, all active
+    out = block_sparse_attention(q, k, v, layout, 16)
+    ref = _dense_reference(q, k, v)
+    assert np.allclose(out, ref, atol=1e-5), np.abs(np.asarray(out - ref)).max()
+
+
+def test_dense_layout_equals_dense_attention_masked():
+    q, k, v = _qkv(jax.random.key(1))
+    mask = jnp.ones((2, 64), dtype=bool).at[:, 50:].set(False)
+    layout = np.ones((4, 4), dtype=bool)
+    out = block_sparse_attention(q, k, v, layout, 16, mask=mask)
+    ref = _dense_reference(q, k, v, mask=mask)
+    assert np.allclose(out[:, :, :50], ref[:, :, :50], atol=1e-5)
+
+
+def test_sparse_layout_restricts_attention():
+    # only the diagonal block active -> each block attends only to itself
+    q, k, v = _qkv(jax.random.key(2), n=32)
+    layout = np.eye(2, dtype=bool)
+    out = block_sparse_attention(q, k, v, layout, 16)
+    ref0 = _dense_reference(q[:, :, :16], k[:, :, :16], v[:, :, :16])
+    assert np.allclose(out[:, :, :16], ref0, atol=1e-5)
+
+
+def test_pallas_matches_jnp():
+    q, k, v = _qkv(jax.random.key(3), n=64, d=16)
+    cfg = BlockSparseConfig(block_size=16, num_random_blocks=1)
+    layout = cfg.layout(64)
+    mask = jnp.ones((2, 64), dtype=bool).at[:, 60:].set(False)
+    from alphafold2_tpu.ops.pallas.block_sparse import pallas_block_sparse_attention
+
+    ref = block_sparse_attention(q, k, v, layout, 16, mask=mask)
+    out = pallas_block_sparse_attention(q, k, v, layout, 16, mask=mask,
+                                        interpret=True)
+    assert np.allclose(out, ref, atol=1e-4), np.abs(np.asarray(out - ref)).max()
+
+
+def test_pallas_dense_layout_oracle():
+    q, k, v = _qkv(jax.random.key(4), n=32, d=8)
+    layout = np.ones((2, 2), dtype=bool)
+    from alphafold2_tpu.ops.pallas.block_sparse import pallas_block_sparse_attention
+
+    out = pallas_block_sparse_attention(q, k, v, layout, 16, interpret=True)
+    ref = _dense_reference(q, k, v)
+    assert np.allclose(out, ref, atol=1e-4)
+
+
+def test_sparse_module_pads_and_preserves_mask():
+    # n=40 not a block multiple: module pads to 48 and composes the caller
+    # mask instead of overwriting it (the reference clobbers it,
+    # alphafold2.py:222 — SURVEY.md S2.5)
+    module = SparseAttention(
+        dim=32, heads=2, dim_head=16, seq_len=64,
+        config=BlockSparseConfig(block_size=16, num_random_blocks=0),
+    )
+    x = jax.random.normal(jax.random.key(5), (1, 40, 32))
+    mask = jnp.ones((1, 40), dtype=bool).at[:, 30:].set(False)
+    params = module.init(jax.random.key(6), x, mask=mask)
+    out = module.apply(params, x, mask=mask)
+    assert out.shape == (1, 40, 32)
+    # masked-out keys must not influence unmasked outputs: perturb them
+    x2 = x.at[:, 35:].add(100.0)
+    out2 = module.apply(params, x2, mask=mask)
+    assert np.allclose(out[:, :30], out2[:, :30], atol=1e-5)
+
+
+def test_model_sparse_pallas_path_matches_jnp():
+    # the Pallas kernel must be reachable from the model config and agree
+    # with the gather-based jnp path on identical params
+    from alphafold2_tpu.models import Alphafold2
+
+    kw = dict(
+        dim=32, depth=1, heads=2, dim_head=16, max_seq_len=512,
+        sparse_self_attn=True,
+        sparse_config=BlockSparseConfig(block_size=16, num_random_blocks=0),
+    )
+    seq = jax.random.randint(jax.random.key(20), (1, 16), 0, 21)
+    mask = jnp.ones((1, 16), dtype=bool)
+    m_jnp = Alphafold2(sparse_use_pallas=False, **kw)
+    m_pal = Alphafold2(sparse_use_pallas=True, **kw)  # interpret mode on CPU
+    params = m_jnp.init(jax.random.key(21), seq, mask=mask)
+    out_jnp = m_jnp.apply(params, seq, mask=mask)
+    out_pal = m_pal.apply(params, seq, mask=mask)
+    assert np.allclose(out_jnp, out_pal, atol=2e-3), (
+        np.abs(np.asarray(out_jnp - out_pal)).max()
+    )
+
+
+def test_model_with_sparse_attn():
+    from alphafold2_tpu.models import Alphafold2
+
+    model = Alphafold2(
+        dim=32, depth=2, heads=2, dim_head=16, max_seq_len=512,
+        sparse_self_attn=(True, False),
+    )
+    seq = jax.random.randint(jax.random.key(7), (1, 8), 0, 21)
+    msa = jax.random.randint(jax.random.key(8), (1, 2, 8), 0, 21)
+    mask = jnp.ones((1, 8), dtype=bool)
+    msa_mask = jnp.ones((1, 2, 8), dtype=bool)
+    params = model.init(jax.random.key(9), seq, msa, mask=mask, msa_mask=msa_mask)
+    out = model.apply(params, seq, msa, mask=mask, msa_mask=msa_mask)
+    assert out.shape == (1, 8, 8, 37)
+    assert np.all(np.isfinite(out))

@@ -68,14 +68,23 @@ def test_train_step_skips_nonfinite():
     model = build_model(cfg)
     state = init_state(cfg, model, batch)
     step = make_train_step(model)
-    bad = dict(batch)
-    bad["coords"] = np.full_like(batch["coords"], np.nan)
-    state2, metrics = step(state, device_put_batch(bad), jax.random.key(1))
+    # poison one parameter leaf -> non-finite forward -> non-finite grads
+    flat = jax.tree.leaves(state.params)
+    poisoned = jax.tree.unflatten(
+        jax.tree.structure(state.params),
+        [l.at[(0,) * l.ndim].set(np.nan) if i == 0 else l
+         for i, l in enumerate(flat)],
+    )
+    # snapshot before the step: the step donates its input state, so the
+    # poisoned device buffers are deleted after the call
+    before = [np.asarray(l) for l in jax.tree.leaves(poisoned)]
+    bad_state = state.replace(params=poisoned)
+    state2, metrics = step(bad_state, device_put_batch(batch), jax.random.key(1))
     assert not bool(metrics["grads_ok"])
     assert int(state2.skipped) == 1
     # params unchanged on skip (grads zeroed; only opt-state counters move)
-    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(state2.params)):
-        assert np.allclose(a, b)
+    for a, b in zip(before, jax.tree.leaves(state2.params)):
+        assert np.allclose(a, b, equal_nan=True)
 
 
 def test_checkpoint_roundtrip(tmp_path):
