@@ -10,4 +10,23 @@ trunks, mesh-sharded pair maps, Pallas kernels for the sparse paths.
 
 __version__ = "0.1.0"
 
+import os as _os
+
 from alphafold2_tpu import constants
+
+
+def setup_platform(default: str | None = None) -> None:
+    """Pin the JAX platform before any backend initializes.
+
+    Drivers call this at startup. ``AF2TPU_PLATFORM`` (e.g. ``cpu``, ``tpu``)
+    wins; otherwise ``default`` is applied when given. This must go through
+    ``jax.config`` — site hooks that register accelerator PJRT plugins may
+    set ``jax_platforms`` programmatically, which overrides the
+    ``JAX_PLATFORMS`` env var, and a dead accelerator tunnel then hangs
+    every ``jax.devices()`` call with no timeout.
+    """
+    platform = _os.environ.get("AF2TPU_PLATFORM", default)
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)

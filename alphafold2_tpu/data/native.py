@@ -1,0 +1,219 @@
+"""ctypes bindings for the native (C++) data-loader runtime.
+
+The reference's data path crosses into native code via mdtraj (C) and torch
+DataLoader workers (SURVEY.md S2.4); ``native/dataloader.cc`` provides this
+framework's equivalent: host-thread batch synthesis + distogram-label
+bucketization behind a bounded prefetch queue, so the accelerator step never
+waits on the Python interpreter (ctypes releases the GIL for the blocking
+``next`` call).
+
+Build once with ``make -C native``; everything degrades gracefully to the
+pure-numpy pipeline (data/pipeline.py) when the shared library is absent.
+
+Public surface:
+- :func:`available` — is the native library loadable?
+- :func:`bucketize_distances` — native twin of
+  utils.structure.get_bucketed_distance_matrix (differentially tested).
+- :class:`NativeSyntheticLoader` — iterator of fixed-shape batch dicts with
+  precomputed ``labels``, produced by C++ worker threads.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from alphafold2_tpu import constants
+from alphafold2_tpu.config import DataConfig
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "libaf2data.so",
+)
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.af2_bucketize_distances.argtypes = [
+        f32p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_float, ctypes.c_float,
+        ctypes.c_int32, i32p,
+    ]
+    lib.af2_bucketize_distances.restype = None
+    lib.af2_synthesize_batch.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_uint64, i32p, i32p, u8p, u8p, f32p, f32p,
+    ]
+    lib.af2_synthesize_batch.restype = None
+    lib.af2_loader_create.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_float, ctypes.c_float, ctypes.c_int32,
+    ]
+    lib.af2_loader_create.restype = ctypes.c_void_p
+    lib.af2_loader_next.argtypes = [
+        ctypes.c_void_p, i32p, i32p, u8p, u8p, f32p, f32p, i32p,
+    ]
+    lib.af2_loader_next.restype = ctypes.c_int
+    lib.af2_loader_queue_size.argtypes = [ctypes.c_void_p]
+    lib.af2_loader_queue_size.restype = ctypes.c_int
+    lib.af2_loader_destroy.argtypes = [ctypes.c_void_p]
+    lib.af2_loader_destroy.restype = None
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def bucketize_distances(
+    coords: np.ndarray,
+    mask: np.ndarray,
+    num_buckets: int = constants.DISTOGRAM_BUCKETS,
+    min_dist: float = constants.DISTOGRAM_MIN_DIST,
+    max_dist: float = constants.DISTOGRAM_MAX_DIST,
+    ignore_index: int = -100,
+) -> np.ndarray:
+    """(N, 3) float32 coords + (N,) bool mask -> (N, N) int32 labels."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    coords = np.ascontiguousarray(coords, np.float32)
+    mask_u8 = np.ascontiguousarray(mask, np.uint8)
+    n = coords.shape[0]
+    out = np.empty((n, n), np.int32)
+    lib.af2_bucketize_distances(
+        _ptr(coords, ctypes.c_float), _ptr(mask_u8, ctypes.c_uint8), n,
+        num_buckets, min_dist, max_dist, ignore_index,
+        _ptr(out, ctypes.c_int32),
+    )
+    return out
+
+
+def synthesize_batch(config: DataConfig, seed: int) -> dict:
+    """One-shot native batch synthesis (deterministic by seed)."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    B, L, M, NM = (
+        config.batch_size, config.crop_len, config.msa_depth, config.msa_len,
+    )
+    out = _alloc(B, L, M, NM, labels=False)
+    lib.af2_synthesize_batch(
+        B, L, M, NM, config.min_len_filter, seed,
+        _ptr(out["seq"], ctypes.c_int32), _ptr(out["msa"], ctypes.c_int32),
+        _ptr(out["_mask_u8"], ctypes.c_uint8),
+        _ptr(out["_msa_mask_u8"], ctypes.c_uint8),
+        _ptr(out["coords"], ctypes.c_float), _ptr(out["backbone"], ctypes.c_float),
+    )
+    return _finish(out)
+
+
+def _alloc(B, L, M, NM, labels: bool) -> dict:
+    out = {
+        "seq": np.empty((B, L), np.int32),
+        "msa": np.empty((B, M, NM), np.int32),
+        "_mask_u8": np.empty((B, L), np.uint8),
+        "_msa_mask_u8": np.empty((B, M, NM), np.uint8),
+        "coords": np.empty((B, L, 3), np.float32),
+        "backbone": np.empty((B, L * 3, 3), np.float32),
+    }
+    if labels:
+        out["labels"] = np.empty((B, L, L), np.int32)
+    return out
+
+def _finish(out: dict) -> dict:
+    out["mask"] = out.pop("_mask_u8").astype(bool)
+    out["msa_mask"] = out.pop("_msa_mask_u8").astype(bool)
+    return out
+
+
+class NativeSyntheticLoader:
+    """Prefetching batch iterator backed by C++ worker threads.
+
+    Yields the same dict schema as data/pipeline.py datasets, plus ``labels``
+    (precomputed distogram targets) so the device step skips the O(N^2)
+    bucketization. The batch STREAM is deterministic for a given seed
+    regardless of ``num_workers`` (workers claim sequential batch indices;
+    the consumer pops in index order). Use as a context manager or call
+    ``close()``.
+    """
+
+    def __init__(
+        self,
+        config: DataConfig,
+        seed: int = 0,
+        num_workers: int = 2,
+        queue_capacity: int = 4,
+        ignore_index: int = -100,
+    ):
+        lib = _load()
+        assert lib is not None, "native library not built (make -C native)"
+        self._lib = lib
+        self.config = config
+        self._handle = lib.af2_loader_create(
+            config.batch_size, config.crop_len, config.msa_depth,
+            config.msa_len, config.min_len_filter, seed, num_workers,
+            queue_capacity, constants.DISTOGRAM_BUCKETS,
+            constants.DISTOGRAM_MIN_DIST, constants.DISTOGRAM_MAX_DIST,
+            ignore_index,
+        )
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict:
+        if self._handle is None:
+            raise StopIteration("loader is closed")
+        cfg = self.config
+        out = _alloc(cfg.batch_size, cfg.crop_len, cfg.msa_depth, cfg.msa_len,
+                     labels=True)
+        rc = self._lib.af2_loader_next(
+            self._handle,
+            _ptr(out["seq"], ctypes.c_int32), _ptr(out["msa"], ctypes.c_int32),
+            _ptr(out["_mask_u8"], ctypes.c_uint8),
+            _ptr(out["_msa_mask_u8"], ctypes.c_uint8),
+            _ptr(out["coords"], ctypes.c_float),
+            _ptr(out["backbone"], ctypes.c_float),
+            _ptr(out["labels"], ctypes.c_int32),
+        )
+        if rc != 0:
+            raise StopIteration
+        return _finish(out)
+
+    def queue_size(self) -> int:
+        if self._handle is None:
+            return 0
+        return int(self._lib.af2_loader_queue_size(self._handle))
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.af2_loader_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -170,6 +170,18 @@ class SidechainnetDataset:
 def make_dataset(config: DataConfig, seed: int = 0):
     if config.source == "synthetic":
         return SyntheticDataset(config, seed=seed)
+    if config.source == "native":
+        from alphafold2_tpu.data import native
+
+        if native.available():
+            return native.NativeSyntheticLoader(config, seed=seed)
+        import warnings
+
+        warnings.warn(
+            "native loader requested but libaf2data.so is not built "
+            "(make -C native); falling back to the numpy pipeline"
+        )
+        return SyntheticDataset(config, seed=seed)
     if config.source == "sidechainnet":
         return SidechainnetDataset(config, seed=seed)
     raise ValueError(f"unknown data source {config.source!r}")

@@ -127,9 +127,13 @@ def make_train_step(model: Alphafold2, mesh: Optional[Mesh] = None):
                     deterministic=False,
                     rngs={"dropout": rng},
                 )
-                labels = get_bucketed_distance_matrix(
-                    batch["coords"], batch["mask"]
-                )
+                # native-loader batches carry host-precomputed labels
+                # (data/native.py); otherwise bucketize on device
+                labels = batch.get("labels")
+                if labels is None:
+                    labels = get_bucketed_distance_matrix(
+                        batch["coords"], batch["mask"]
+                    )
                 return distogram_cross_entropy(logits, labels), logits
 
             (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -192,6 +196,7 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
     from alphafold2_tpu.train.observe import MetricsLogger, Profiler
 
     num_steps = num_steps or cfg.train.num_steps
+    owns_dataset = dataset is None
     dataset = dataset or make_dataset(cfg.data, seed=cfg.train.seed)
     data_iter = iter(dataset)
 
@@ -240,4 +245,6 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
     if ckpt is not None:
         ckpt.save(num_steps, state)
         ckpt.wait()
+    if owns_dataset and hasattr(dataset, "close"):
+        dataset.close()  # shut down native prefetch workers
     return state

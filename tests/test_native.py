@@ -1,0 +1,138 @@
+"""Native data-loader runtime tests: differential vs the jnp bucketization
+oracle, batch-schema/determinism properties, and the threaded prefetch queue.
+Skipped wholesale when the shared library hasn't been built
+(``make -C native``)."""
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu.config import DataConfig
+from alphafold2_tpu.data import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not built (make -C native)"
+)
+
+
+def _cfg(**kw):
+    base = dict(crop_len=24, msa_depth=2, msa_len=16, batch_size=2,
+                min_len_filter=8)
+    base.update(kw)
+    return DataConfig(**base)
+
+
+def test_bucketize_matches_jnp_oracle():
+    from alphafold2_tpu.utils.structure import get_bucketed_distance_matrix
+
+    rng = np.random.default_rng(0)
+    coords = rng.normal(scale=8.0, size=(48, 3)).astype(np.float32)
+    mask = np.ones(48, bool)
+    mask[40:] = False
+    got = native.bucketize_distances(coords, mask)
+    want = np.asarray(get_bucketed_distance_matrix(coords[None], mask[None]))[0]
+    # float assoc. differences may shift distances sitting exactly on a bin
+    # edge by one bucket; require exact agreement on (nearly) all entries
+    mismatch = (got != want).mean()
+    assert mismatch < 1e-3, f"mismatch fraction {mismatch}"
+    assert (got[~mask[:, None] | ~mask[None, :]] == -100).all()
+
+
+def test_synthesize_batch_schema_and_determinism():
+    cfg = _cfg()
+    b1 = native.synthesize_batch(cfg, seed=7)
+    b2 = native.synthesize_batch(cfg, seed=7)
+    b3 = native.synthesize_batch(cfg, seed=8)
+    assert b1["seq"].shape == (2, 24) and b1["msa"].shape == (2, 2, 16)
+    assert b1["coords"].shape == (2, 24, 3) and b1["backbone"].shape == (2, 72, 3)
+    for k in ("seq", "msa", "coords"):
+        assert np.array_equal(b1[k], b2[k]), k  # same seed -> same batch
+    assert not np.array_equal(b1["seq"], b3["seq"])  # different seed
+
+    # masked-out tail is padding; valid region is in-vocab
+    for b in range(2):
+        n = int(b1["mask"][b].sum())
+        assert (b1["seq"][b, :n] < 20).all()
+        assert (b1["seq"][b, n:] == 20).all()
+        # consecutive CA distance ~3.8A in the valid region
+        ca = b1["coords"][b, :n]
+        steps = np.linalg.norm(np.diff(ca, axis=0), axis=-1)
+        assert np.allclose(steps, 3.8, atol=0.2)
+        # N/CA/C backbone triplets bracket each CA
+        bb = b1["backbone"][b, : n * 3].reshape(n, 3, 3)
+        assert np.allclose(bb[:, 1], ca, atol=1e-6)
+        assert (np.linalg.norm(bb[:, 0] - ca, axis=-1) < 2.5).all()
+
+
+def test_prefetch_loader_streams_batches():
+    cfg = _cfg()
+    with native.NativeSyntheticLoader(cfg, seed=0, num_workers=2,
+                                      queue_capacity=3) as loader:
+        seqs = []
+        for _ in range(5):
+            batch = next(loader)
+            assert batch["labels"].shape == (2, 24, 24)
+            # labels agree with a host recomputation from the same coords
+            want = native.bucketize_distances(batch["coords"][0], batch["mask"][0])
+            assert np.array_equal(batch["labels"][0], want)
+            seqs.append(batch["seq"].copy())
+        # worker seeds advance: batches are not all identical
+        assert any(not np.array_equal(seqs[0], s) for s in seqs[1:])
+
+
+def test_train_step_consumes_native_batches():
+    import jax
+
+    from alphafold2_tpu.config import Config, ModelConfig, TrainConfig
+    from alphafold2_tpu.data.pipeline import make_dataset
+    from alphafold2_tpu.train.loop import (
+        build_model, device_put_batch, init_state, make_train_step,
+    )
+
+    cfg = Config(
+        model=ModelConfig(dim=32, depth=1, heads=2, dim_head=16,
+                          max_seq_len=64, bfloat16=False),
+        data=_cfg(crop_len=16, msa_len=16, source="native"),
+        train=TrainConfig(gradient_accumulate_every=1, warmup_steps=2),
+    )
+    loader = make_dataset(cfg.data, seed=0)
+    assert isinstance(loader, native.NativeSyntheticLoader)
+    with loader:
+        batch = next(loader)
+        model = build_model(cfg)
+        state = init_state(cfg, model, batch)
+        step = make_train_step(model)
+        state, metrics = step(state, device_put_batch(batch), jax.random.key(0))
+        assert np.isfinite(float(metrics["loss"]))
+        assert bool(metrics["grads_ok"])
+
+
+def test_loader_stream_deterministic_across_worker_counts():
+    # same seed, different worker counts -> byte-identical batch stream
+    # (workers claim sequential indices; consumer pops in index order)
+    def take(n_workers, n_batches=4):
+        with native.NativeSyntheticLoader(_cfg(), seed=3,
+                                          num_workers=n_workers) as ld:
+            return [next(ld) for _ in range(n_batches)]
+
+    a, b = take(1), take(3)
+    for ba, bb in zip(a, b):
+        for k in ("seq", "msa", "coords", "labels"):
+            assert np.array_equal(ba[k], bb[k]), k
+
+
+def test_loader_close_idempotent():
+    loader = native.NativeSyntheticLoader(_cfg(), seed=1, num_workers=1)
+    next(loader)
+    loader.close()
+    loader.close()  # double-close must not crash
+    with pytest.raises(StopIteration):
+        next(loader)  # closed loader must not touch the C side
+    assert loader.queue_size() == 0
+
+
+def test_min_len_exceeds_crop_len_is_safe():
+    # numpy twin raises for this config; native clamps instead of corrupting
+    cfg = _cfg(crop_len=8, min_len_filter=16)
+    b = native.synthesize_batch(cfg, seed=0)
+    assert b["mask"].all()  # chain fills the whole crop
+    assert (b["seq"] < 20).all()

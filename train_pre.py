@@ -9,10 +9,12 @@ Usage:
 
 import sys
 
+import alphafold2_tpu
 from alphafold2_tpu.config import Config, ModelConfig, parse_cli
 
 
 def main(argv):
+    alphafold2_tpu.setup_platform()  # AF2TPU_PLATFORM=cpu to force host
     base = Config(model=ModelConfig(dim=256, depth=1))  # train_pre.py:52-57
     cfg = parse_cli(argv, base)
     print("config:", cfg.to_json())
