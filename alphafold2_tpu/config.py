@@ -29,6 +29,9 @@ class ModelConfig:
     sparse_self_attn: bool = False
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
+    # sequence/context parallelism for the cross-attention over the N^2 pair
+    # tokens: None | "ring" | "ulysses" (parallel/seq_parallel.py)
+    context_parallel: Optional[str] = None
     template_attn_depth: int = 2
     bfloat16: bool = True  # compute dtype on TPU
 
@@ -51,6 +54,11 @@ class DataConfig:
     casp_version: int = 12
     thinning: int = 30
     data_dir: Optional[str] = None
+    # feature stream fed beside the sequence (reference train_end2end.py:22-28
+    # FEATURES): "msa" | "plm" (frozen PLM embeddings via data/plm.py) | "none"
+    features: str = "msa"
+    plm_provider: str = "hash"  # "hash" | "precomputed" | "esm"
+    plm_path: Optional[str] = None  # .npz archive for "precomputed"
 
 
 @dataclass

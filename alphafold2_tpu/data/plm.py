@@ -1,0 +1,138 @@
+"""Protein-language-model embedding providers for the ``embedds`` input path.
+
+The reference feeds frozen ESM-1b residue embeddings (1280-dim) into the
+model via torch.hub (reference train_end2end.py:37-43,54-59: download ~30GB,
+run under no_grad, project 1280->dim). The TPU framework keeps the same
+boundary — the model's ``embedds`` argument + ``embedd_project`` — and makes
+the provider pluggable:
+
+- :class:`HashProjectionProvider` — hermetic, dependency-free stand-in: a
+  fixed random projection of one-hot residue identity + position features to
+  ``dim`` (deterministic per seed). Lets the full PLM input path train and
+  test in environments with no model weights or network.
+- :class:`PrecomputedProvider` — loads embeddings exported ahead of time to
+  ``.npz`` (key = sequence string), the standard workflow for frozen-PLM
+  features on TPU pods (embed once on any machine, stream arrays).
+- :class:`TransformersESMProvider` — runs a HuggingFace ESM checkpoint
+  (e.g. ``facebook/esm1b_t33_650M_UR50S``) when its weights are available
+  locally; import/download is gated with a clear error.
+
+- :func:`wrap_with_embeddings` — dataset adapter: adds ``embedds`` to each
+  batch and drops the MSA (the two are mutually exclusive model inputs,
+  reference alphafold2.py:493-496); the train steps pick whichever key the
+  batch carries.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from alphafold2_tpu import constants
+
+
+class HashProjectionProvider:
+    """Deterministic pseudo-PLM: fixed random projection of (one-hot AA,
+    sinusoidal position) features to ``dim``. Zero dependencies; the point is
+    exercising the embedds path end-to-end, not biological signal."""
+
+    def __init__(self, dim: int = constants.NUM_EMBEDDS_TR, seed: int = 0):
+        self.dim = dim
+        rng = np.random.default_rng(seed)
+        self._aa_table = rng.normal(
+            scale=1.0, size=(constants.NUM_AMINO_ACIDS, dim)
+        ).astype(np.float32)
+
+    def __call__(self, seq: np.ndarray) -> np.ndarray:
+        """(B, L) int tokens -> (B, L, dim) float32 embeddings."""
+        seq = np.asarray(seq)
+        emb = self._aa_table[seq]  # (B, L, dim)
+        pos = np.arange(seq.shape[1], dtype=np.float32)
+        freqs = np.exp(
+            -np.log(10000.0)
+            * np.arange(0, self.dim, 2, dtype=np.float32)
+            / self.dim
+        )
+        ang = pos[:, None] * freqs[None, :]
+        pe = np.zeros((seq.shape[1], self.dim), np.float32)
+        pe[:, 0::2] = np.sin(ang)[:, : pe[:, 0::2].shape[1]]
+        pe[:, 1::2] = np.cos(ang)[:, : pe[:, 1::2].shape[1]]
+        return emb + pe[None]
+
+
+class PrecomputedProvider:
+    """Looks embeddings up from an ``.npz`` archive keyed by sequence string
+    (letters from AA_ALPHABET). Missing sequences raise KeyError."""
+
+    def __init__(self, npz_path: str):
+        self._store = np.load(npz_path)
+
+    def __call__(self, seq: np.ndarray) -> np.ndarray:
+        seq = np.asarray(seq)
+        out = []
+        for row in seq:
+            key = "".join(
+                constants.AA_ALPHABET[t] if t < 20 else "X" for t in row
+            )
+            out.append(np.asarray(self._store[key], np.float32))
+        return np.stack(out)
+
+
+class TransformersESMProvider:
+    """Frozen ESM via HuggingFace ``transformers`` (the reference's ESM-1b
+    boundary, minus torch.hub). Requires the checkpoint to be locally
+    available; gated with a clear error otherwise."""
+
+    def __init__(self, model_name: str = "facebook/esm1b_t33_650M_UR50S"):
+        try:
+            import torch  # noqa: F401
+            from transformers import AutoModel, AutoTokenizer
+        except ImportError as e:  # pragma: no cover - env-dependent
+            raise ImportError("transformers+torch required for ESM") from e
+        try:
+            self._tok = AutoTokenizer.from_pretrained(
+                model_name, local_files_only=True
+            )
+            self._model = AutoModel.from_pretrained(
+                model_name, local_files_only=True
+            ).eval()
+        except OSError as e:  # pragma: no cover - env-dependent
+            raise RuntimeError(
+                f"ESM checkpoint {model_name!r} not cached locally and this "
+                "environment has no network; precompute embeddings elsewhere "
+                "and use PrecomputedProvider"
+            ) from e
+
+    def __call__(self, seq: np.ndarray) -> np.ndarray:  # pragma: no cover
+        import torch
+
+        seqs = [
+            "".join(constants.AA_ALPHABET[t] if t < 20 else "X" for t in row)
+            for row in np.asarray(seq)
+        ]
+        with torch.no_grad():
+            toks = self._tok(seqs, return_tensors="pt", padding=True)
+            h = self._model(**toks).last_hidden_state
+        return h[:, 1 : 1 + seq.shape[1]].float().numpy()
+
+
+def make_provider(kind: str, dim: int = constants.NUM_EMBEDDS_TR,
+                  path: Optional[str] = None, seed: int = 0):
+    if kind == "hash":
+        return HashProjectionProvider(dim=dim, seed=seed)
+    if kind == "precomputed":
+        assert path, "precomputed provider needs data.plm_path"
+        return PrecomputedProvider(path)
+    if kind == "esm":
+        return TransformersESMProvider()
+    raise ValueError(f"unknown plm provider {kind!r}")
+
+
+def wrap_with_embeddings(dataset, provider) -> Iterator[dict]:
+    """Adapter: stream batches with ``embedds`` added and the MSA removed
+    (embedds and MSA are mutually exclusive model inputs)."""
+    for batch in dataset:
+        out = {k: v for k, v in batch.items() if k not in ("msa", "msa_mask")}
+        out["embedds"] = provider(batch["seq"])
+        yield out

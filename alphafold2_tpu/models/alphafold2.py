@@ -116,6 +116,7 @@ class Alphafold2(nn.Module):
     sparse_use_pallas: Optional[bool] = None  # None -> Pallas kernel on TPU
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
+    context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
     template_attn_depth: int = 2
     use_se3_template_embedder: bool = True
     dtype: jnp.dtype = jnp.float32
@@ -248,6 +249,7 @@ class Alphafold2(nn.Module):
             sparse_use_pallas=self.sparse_use_pallas,
             cross_attn_compress_ratio=self.cross_attn_compress_ratio,
             msa_tie_row_attn=self.msa_tie_row_attn,
+            context_parallel=self.context_parallel,
             remat=self.remat,
             dtype=dt,
             name="trunk",

@@ -47,6 +47,7 @@ class TrunkLayer(nn.Module):
     sparse_use_pallas: Optional[bool] = None
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
+    context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -107,6 +108,7 @@ class TrunkLayer(nn.Module):
                 dim_head=self.dim_head,
                 dropout=self.attn_dropout,
                 compress_ratio=self.cross_attn_compress_ratio,
+                context_parallel=self.context_parallel,
                 dtype=dt,
                 name="pair_from_msa",
             )(
@@ -121,6 +123,7 @@ class TrunkLayer(nn.Module):
                 heads=self.heads,
                 dim_head=self.dim_head,
                 dropout=self.attn_dropout,
+                context_parallel=self.context_parallel,
                 dtype=dt,
                 name="msa_from_pair",
             )(
@@ -163,6 +166,7 @@ class Trunk(nn.Module):
     sparse_use_pallas: Optional[bool] = None
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
+    context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
     remat: bool = False
     dtype: jnp.dtype = jnp.float32
 
@@ -192,6 +196,7 @@ class Trunk(nn.Module):
                 sparse_use_pallas=self.sparse_use_pallas,
                 cross_attn_compress_ratio=self.cross_attn_compress_ratio,
                 msa_tie_row_attn=self.msa_tie_row_attn,
+                context_parallel=self.context_parallel,
                 dtype=self.dtype,
                 name=f"layer_{i}",
             )(x, m, pair_mask, msa_mask, deterministic)

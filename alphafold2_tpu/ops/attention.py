@@ -72,6 +72,7 @@ class Attention(nn.Module):
     dim_head: int = 64
     dropout: float = 0.0
     compress_ratio: int = 1
+    context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -129,6 +130,40 @@ class Attention(nn.Module):
 
         q, k, v = split_heads(q), split_heads(k), split_heads(v)  # (B, n, h, dh)
         scale = dh**-0.5
+
+        # context-parallel path: exact attention with the sequence axis
+        # sharded over the mesh's sp axis (ring ppermute or Ulysses
+        # all-to-all — parallel/seq_parallel.py). Taken when a mesh is
+        # active and the call has no tied rows / KV compression; attention-
+        # weight dropout is a dense-path-only feature, so training with
+        # attn dropout > 0 falls through to the dense path.
+        if (
+            self.context_parallel is not None
+            and tie_dim is None
+            and self.compress_ratio == 1
+            and (self.dropout == 0.0 or deterministic)
+        ):
+            from alphafold2_tpu.parallel.seq_parallel import (
+                SEQ_AXIS_NAME,
+                sequence_parallel_attention,
+            )
+            from alphafold2_tpu.parallel.sharding import active_mesh
+
+            mesh = active_mesh()
+            if mesh is not None and SEQ_AXIS_NAME in mesh.axis_names:
+                km = context_mask
+                if km is None and not has_context:
+                    km = mask
+                out = sequence_parallel_attention(
+                    jnp.moveaxis(q, -2, 1),
+                    jnp.moveaxis(k, -2, 1),
+                    jnp.moveaxis(v, -2, 1),
+                    mask=km,
+                    mesh=mesh,
+                    impl=self.context_parallel,
+                )  # (B, H, n, dh)
+                out = jnp.moveaxis(out, 1, -2).reshape(*x.shape[:-1], inner)
+                return nn.Dense(self.dim, dtype=self.dtype, name="to_out")(out)
 
         if tie_dim is not None:
             # (B*R, n, h, d) -> (B, R, n, h, d); one attention matrix per (B, h)

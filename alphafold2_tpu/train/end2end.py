@@ -68,6 +68,7 @@ class End2EndModel(nn.Module):
     refiner_depth: int = 2
     remat: bool = False
     msa_tie_row_attn: bool = False
+    context_parallel: Optional[str] = None
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -76,10 +77,14 @@ class End2EndModel(nn.Module):
         b, l = seq.shape
         seq3, mask3 = elongate(seq, mask)
 
+        if embedds is not None:
+            # PLM embeddings are per-residue; elongate x3 alongside the tokens
+            embedds = jnp.repeat(embedds, 3, axis=1)
         logits = Alphafold2(
             dim=self.dim, depth=self.depth, heads=self.heads,
             dim_head=self.dim_head, max_seq_len=self.max_seq_len,
             remat=self.remat, msa_tie_row_attn=self.msa_tie_row_attn,
+            context_parallel=self.context_parallel,
             dtype=self.dtype, name="af2",
         )(seq3, msa, mask=mask3, msa_mask=msa_mask, embedds=embedds,
           deterministic=deterministic)
@@ -152,9 +157,10 @@ def make_end2end_step(model: End2EndModel, mesh: Optional[Mesh] = None):
                 out = model.apply(
                     params,
                     batch["seq"],
-                    batch["msa"],
+                    batch.get("msa"),
                     mask=batch["mask"],
-                    msa_mask=batch["msa_mask"],
+                    msa_mask=batch.get("msa_mask"),
+                    embedds=batch.get("embedds"),
                     mds_key=mds_rng,
                     deterministic=False,
                     rngs={"dropout": drop_rng},
@@ -195,12 +201,18 @@ def make_end2end_step(model: End2EndModel, mesh: Optional[Mesh] = None):
 
 def init_end2end_state(cfg: Config, model: End2EndModel, batch: dict) -> TrainState:
     rng = jax.random.key(cfg.train.seed)
+
+    def opt(key):
+        v = batch.get(key)
+        return jnp.asarray(v) if v is not None else None
+
     params = model.init(
         rng,
         jnp.asarray(batch["seq"]),
-        jnp.asarray(batch["msa"]),
+        opt("msa"),
         mask=jnp.asarray(batch["mask"]),
-        msa_mask=jnp.asarray(batch["msa_mask"]),
+        msa_mask=opt("msa_mask"),
+        embedds=opt("embedds"),
     )
     return TrainState.create(
         apply_fn=model.apply,
@@ -216,12 +228,13 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
 
     from alphafold2_tpu.data.pipeline import make_dataset
     from alphafold2_tpu.parallel.sharding import make_mesh
-    from alphafold2_tpu.train.loop import device_put_batch
+    from alphafold2_tpu.train.loop import apply_features, device_put_batch
     from alphafold2_tpu.train.observe import MetricsLogger
 
     num_steps = num_steps or cfg.train.num_steps
+    owns_dataset = dataset is None
     dataset = dataset or make_dataset(cfg.data, seed=cfg.train.seed)
-    data_iter = iter(dataset)
+    data_iter = apply_features(iter(dataset), cfg)
     mesh = None
     if cfg.mesh.data_parallel * cfg.mesh.seq_parallel > 1:
         mesh = make_mesh(cfg.mesh.data_parallel, cfg.mesh.seq_parallel)
@@ -230,6 +243,7 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
         dim=cfg.model.dim, depth=cfg.model.depth, heads=cfg.model.heads,
         dim_head=cfg.model.dim_head, max_seq_len=cfg.model.max_seq_len,
         remat=cfg.model.remat, msa_tie_row_attn=cfg.model.msa_tie_row_attn,
+        context_parallel=cfg.model.context_parallel,
         dtype=jnp.bfloat16 if cfg.model.bfloat16 else jnp.float32,
     )
     sample = next(data_iter)
@@ -251,4 +265,6 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
             t0 = time.perf_counter()
             logger.log(i, m)
         batch = device_put_batch(next(data_iter), mesh)
+    if owns_dataset and hasattr(dataset, "close"):
+        dataset.close()
     return state

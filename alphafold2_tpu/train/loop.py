@@ -52,6 +52,27 @@ def distogram_cross_entropy(
     return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
 
 
+def apply_features(data_iter, cfg: Config):
+    """Adapt the batch stream to data.features: "msa" (as-is), "plm" (frozen
+    PLM embeddings replace the MSA — reference train_end2end.py FEATURES),
+    or "none" (sequence only)."""
+    if cfg.data.features == "plm":
+        from alphafold2_tpu.data.plm import make_provider, wrap_with_embeddings
+
+        provider = make_provider(
+            cfg.data.plm_provider, path=cfg.data.plm_path, seed=cfg.train.seed
+        )
+        return wrap_with_embeddings(data_iter, provider)
+    if cfg.data.features == "none":
+        return (
+            {k: v for k, v in b.items() if k not in ("msa", "msa_mask")}
+            for b in data_iter
+        )
+    if cfg.data.features != "msa":
+        raise ValueError(f"unknown data.features {cfg.data.features!r}")
+    return data_iter
+
+
 def build_model(cfg: Config) -> Alphafold2:
     m = cfg.model
     return Alphafold2(
@@ -66,6 +87,7 @@ def build_model(cfg: Config) -> Alphafold2:
         sparse_self_attn=m.sparse_self_attn,
         cross_attn_compress_ratio=m.cross_attn_compress_ratio,
         msa_tie_row_attn=m.msa_tie_row_attn,
+        context_parallel=m.context_parallel,
         template_attn_depth=m.template_attn_depth,
         dtype=jnp.bfloat16 if m.bfloat16 else jnp.float32,
     )
@@ -91,12 +113,18 @@ def build_optimizer(cfg: Config) -> optax.GradientTransformation:
 
 def init_state(cfg: Config, model: Alphafold2, sample_batch: dict) -> TrainState:
     rng = jax.random.key(cfg.train.seed)
+
+    def opt(key):
+        v = sample_batch.get(key)
+        return jnp.asarray(v) if v is not None else None
+
     params = model.init(
         rng,
         jnp.asarray(sample_batch["seq"]),
-        jnp.asarray(sample_batch["msa"]),
+        opt("msa"),
         mask=jnp.asarray(sample_batch["mask"]),
-        msa_mask=jnp.asarray(sample_batch["msa_mask"]),
+        msa_mask=opt("msa_mask"),
+        embedds=opt("embedds"),
     )
     return TrainState.create(
         apply_fn=model.apply,
@@ -121,9 +149,10 @@ def make_train_step(model: Alphafold2, mesh: Optional[Mesh] = None):
                 logits = model.apply(
                     params,
                     batch["seq"],
-                    batch["msa"],
+                    batch.get("msa"),
                     mask=batch["mask"],
-                    msa_mask=batch["msa_mask"],
+                    msa_mask=batch.get("msa_mask"),
+                    embedds=batch.get("embedds"),  # frozen-PLM feature path
                     deterministic=False,
                     rngs={"dropout": rng},
                 )
@@ -198,7 +227,7 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
     num_steps = num_steps or cfg.train.num_steps
     owns_dataset = dataset is None
     dataset = dataset or make_dataset(cfg.data, seed=cfg.train.seed)
-    data_iter = iter(dataset)
+    data_iter = apply_features(iter(dataset), cfg)
 
     mesh = None
     n_mesh = cfg.mesh.data_parallel * cfg.mesh.seq_parallel

@@ -1,0 +1,112 @@
+"""End-to-end pipeline tests: the corrected realization of the reference's
+train_end2end.py sketch (which crashes as written — SURVEY.md S2.5). Covers
+the elongation reshape, the full distogram->MDS->sidechain->SE(3)->Kabsch
+forward, one jitted training step, and the loss surface."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from alphafold2_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from alphafold2_tpu.data.pipeline import SyntheticDataset
+from alphafold2_tpu.train.end2end import (
+    End2EndModel,
+    elongate,
+    init_end2end_state,
+    make_end2end_step,
+    structure_loss,
+)
+from alphafold2_tpu.train.loop import device_put_batch
+
+
+def tiny_cfg():
+    return Config(
+        model=ModelConfig(dim=32, depth=1, heads=2, dim_head=16,
+                          max_seq_len=64, bfloat16=False),
+        data=DataConfig(crop_len=8, msa_depth=2, msa_len=8, batch_size=1,
+                        min_len_filter=8),
+        train=TrainConfig(gradient_accumulate_every=1, warmup_steps=2),
+    )
+
+
+def tiny_model():
+    return End2EndModel(dim=32, depth=1, heads=2, dim_head=16, max_seq_len=64,
+                        mds_iters=20, refiner_depth=1)
+
+
+def test_elongate():
+    seq = jnp.asarray([[3, 7]])
+    mask = jnp.asarray([[True, False]])
+    seq3, mask3 = elongate(seq, mask)
+    assert seq3.tolist() == [[3, 3, 3, 7, 7, 7]]
+    assert mask3.tolist() == [[True, True, True, False, False, False]]
+
+
+def test_forward_produces_structures():
+    cfg = tiny_cfg()
+    batch = next(iter(SyntheticDataset(cfg.data, seed=0)))
+    model = tiny_model()
+    params = model.init(
+        jax.random.key(0), jnp.asarray(batch["seq"]), jnp.asarray(batch["msa"]),
+        mask=jnp.asarray(batch["mask"]), msa_mask=jnp.asarray(batch["msa_mask"]),
+    )
+    out = model.apply(
+        params, jnp.asarray(batch["seq"]), jnp.asarray(batch["msa"]),
+        mask=jnp.asarray(batch["mask"]), msa_mask=jnp.asarray(batch["msa_mask"]),
+    )
+    L = cfg.data.crop_len
+    assert out["distogram"].shape == (1, 3 * L, 3 * L, 37)
+    assert out["proto"].shape == (1, L, 14, 3)
+    assert out["refined"].shape == (1, L, 14, 3)
+    for v in out.values():
+        assert np.all(np.isfinite(np.asarray(v)))
+    # realized distances should be in a protein-plausible range, not collapsed
+    ca = np.asarray(out["refined"])[0, :, 1]
+    d = np.linalg.norm(ca[None] - ca[:, None], axis=-1)
+    assert d.max() > 1.0
+
+
+def test_structure_loss_zero_for_perfect_prediction():
+    rng = np.random.default_rng(0)
+    L = 6
+    bb_true = rng.normal(scale=5.0, size=(1, 3 * L, 3)).astype(np.float32)
+    refined = np.tile(
+        bb_true.reshape(1, L, 3, 3)[:, :, 1:2], (1, 1, 14, 1)
+    ).astype(np.float32)
+    refined[:, :, :3] = bb_true.reshape(1, L, 3, 3)
+    out = {
+        "refined": jnp.asarray(refined),
+        "weights": jnp.ones((1, 3 * L, 3 * L)),
+    }
+    loss, aux = structure_loss(out, jnp.asarray(bb_true), jnp.ones((1, L), bool))
+    assert float(aux["rmsd"]) < 1e-3
+    assert float(aux["dispersion"]) < 1e-6
+
+
+def test_end2end_step_on_plm_features():
+    from alphafold2_tpu.data.plm import make_provider, wrap_with_embeddings
+
+    cfg = tiny_cfg()
+    provider = make_provider("hash", dim=1280)
+    stream = wrap_with_embeddings(iter(SyntheticDataset(cfg.data, seed=1)),
+                                  provider)
+    batch = next(stream)
+    model = tiny_model()
+    state = init_end2end_state(cfg, model, batch)
+    step = make_end2end_step(model)
+    state, metrics = step(state, device_put_batch(batch), jax.random.key(0))
+    assert np.isfinite(float(metrics["loss"]))
+    assert bool(metrics["grads_ok"])
+
+
+def test_end2end_step_runs_and_grads_flow():
+    cfg = tiny_cfg()
+    batch = next(iter(SyntheticDataset(cfg.data, seed=0)))
+    model = tiny_model()
+    state = init_end2end_state(cfg, model, batch)
+    step = make_end2end_step(model)
+    state, metrics = step(state, device_put_batch(batch), jax.random.key(0))
+    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(float(metrics["rmsd"]))
+    assert bool(metrics["grads_ok"])
+    assert float(metrics["grad_norm"]) > 0.0  # gradients reach the trunk

@@ -57,6 +57,33 @@ def test_dp_sp_step_matches_single_device():
         assert np.allclose(a, b, atol=1e-4)
 
 
+def test_ring_context_parallel_matches_dense_cross_attn():
+    # model with context_parallel="ring": the trunk cross-attention runs via
+    # shard_map ppermute ring; numbers must match the dense path exactly
+    cfg = _cfg(batch_size=2)
+    cfg2 = Config(
+        model=ModelConfig(dim=32, depth=1, heads=2, dim_head=16, max_seq_len=64,
+                          bfloat16=False, context_parallel="ring"),
+        data=cfg.data, train=cfg.train,
+    )
+    batch = next(iter(SyntheticDataset(cfg.data, seed=2)))
+    model_dense = build_model(cfg)
+    model_ring = build_model(cfg2)
+    state = init_state(cfg, model_dense, batch)
+
+    step_dense = make_train_step(model_dense, mesh=None)
+    _, m_dense = step_dense(state, device_put_batch(batch), jax.random.key(3))
+
+    mesh = make_mesh(2, 4)
+    state2 = init_state(cfg2, model_ring, batch)
+    step_ring = make_train_step(model_ring, mesh=mesh)
+    _, m_ring = step_ring(state2, device_put_batch(batch, mesh), jax.random.key(3))
+
+    assert np.isclose(float(m_dense["loss"]), float(m_ring["loss"]), rtol=1e-4), (
+        float(m_dense["loss"]), float(m_ring["loss"]),
+    )
+
+
 def test_sp_only_mesh():
     cfg = _cfg(batch_size=1)
     batch = next(iter(SyntheticDataset(cfg.data, seed=1)))
