@@ -32,6 +32,8 @@ class ModelConfig:
     # sequence/context parallelism for the cross-attention over the N^2 pair
     # tokens: None | "ring" | "ulysses" (parallel/seq_parallel.py)
     context_parallel: Optional[str] = None
+    # fused Pallas flash attention for dense paths: None = auto (on TPU)
+    flash_attention: Optional[bool] = None
     template_attn_depth: int = 2
     bfloat16: bool = True  # compute dtype on TPU
 

@@ -50,6 +50,7 @@ class TemplateBlock(nn.Module):
     heads: int
     dim_head: int
     dropout: float = 0.0
+    use_flash: Optional[bool] = None
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -61,14 +62,16 @@ class TemplateBlock(nn.Module):
 
         x = AxialAttention(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
-            dropout=self.dropout, dtype=self.dtype, name="pair_axial",
+            dropout=self.dropout, use_flash=self.use_flash, dtype=self.dtype,
+            name="pair_axial",
         )(ln("pair_norm")(x), mask=pair_mask, deterministic=deterministic)
 
         t_flat = t.reshape(b * T, n, n, d)
         tm_flat = t_mask.reshape(b * T, n, n) if t_mask is not None else None
         t_flat = t_flat + AxialAttention(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
-            dropout=self.dropout, dtype=self.dtype, name="template_axial",
+            dropout=self.dropout, use_flash=self.use_flash, dtype=self.dtype,
+            name="template_axial",
         )(ln("template_norm")(t_flat), mask=tm_flat, deterministic=deterministic)
         t = t_flat.reshape(b, T, n, n, d)
 
@@ -81,7 +84,8 @@ class TemplateBlock(nn.Module):
             y_mask = jnp.moveaxis(ym, 1, 3).reshape(b * n * n, 1 + T)
         y = y + Attention(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
-            dropout=self.dropout, dtype=self.dtype, name="template_axis_attn",
+            dropout=self.dropout, use_flash=self.use_flash, dtype=self.dtype,
+            name="template_axis_attn",
         )(ln("template_axis_norm")(y), mask=y_mask, deterministic=deterministic)
         y = jnp.moveaxis(y.reshape(b, n, n, 1 + T, d), 3, 1)
         x, t = y[:, 0], y[:, 1:]
@@ -117,6 +121,7 @@ class Alphafold2(nn.Module):
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
     context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
+    use_flash: Optional[bool] = None  # fused dense attention kernel on TPU
     template_attn_depth: int = 2
     use_se3_template_embedder: bool = True
     dtype: jnp.dtype = jnp.float32
@@ -231,7 +236,8 @@ class Alphafold2(nn.Module):
             for i in range(self.template_attn_depth):
                 x, t = TemplateBlock(
                     dim=self.dim, heads=self.heads, dim_head=self.dim_head,
-                    dropout=self.attn_dropout, dtype=dt, name=f"template_block_{i}",
+                    dropout=self.attn_dropout, use_flash=self.use_flash,
+                    dtype=dt, name=f"template_block_{i}",
                 )(x, t, pair_mask, t_mask, deterministic=deterministic)
             x = shard_pair(x)
 
@@ -250,6 +256,7 @@ class Alphafold2(nn.Module):
             cross_attn_compress_ratio=self.cross_attn_compress_ratio,
             msa_tie_row_attn=self.msa_tie_row_attn,
             context_parallel=self.context_parallel,
+            use_flash=self.use_flash,
             remat=self.remat,
             dtype=dt,
             name="trunk",

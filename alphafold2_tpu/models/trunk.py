@@ -48,6 +48,7 @@ class TrunkLayer(nn.Module):
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
     context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
+    use_flash: Optional[bool] = None  # fused dense attention on TPU
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -72,6 +73,7 @@ class TrunkLayer(nn.Module):
             seq_len=self.seq_len,
             sparse_config=self.sparse_config,
             sparse_use_pallas=self.sparse_use_pallas,
+            use_flash=self.use_flash,
             dtype=dt,
             name="pair_axial",
         )(ln("pair_axial_norm")(x), mask=pair_mask, deterministic=deterministic)
@@ -85,6 +87,7 @@ class TrunkLayer(nn.Module):
                 dim_head=self.dim_head,
                 dropout=self.attn_dropout,
                 tie_row_attn=self.msa_tie_row_attn,
+                use_flash=self.use_flash,
                 dtype=dt,
                 name="msa_axial",
             )(ln("msa_axial_norm")(m), mask=msa_mask, deterministic=deterministic)
@@ -109,6 +112,7 @@ class TrunkLayer(nn.Module):
                 dropout=self.attn_dropout,
                 compress_ratio=self.cross_attn_compress_ratio,
                 context_parallel=self.context_parallel,
+                use_flash=self.use_flash,
                 dtype=dt,
                 name="pair_from_msa",
             )(
@@ -124,6 +128,7 @@ class TrunkLayer(nn.Module):
                 dim_head=self.dim_head,
                 dropout=self.attn_dropout,
                 context_parallel=self.context_parallel,
+                use_flash=self.use_flash,
                 dtype=dt,
                 name="msa_from_pair",
             )(
@@ -167,6 +172,7 @@ class Trunk(nn.Module):
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
     context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
+    use_flash: Optional[bool] = None  # fused dense attention on TPU
     remat: bool = False
     dtype: jnp.dtype = jnp.float32
 
@@ -197,6 +203,7 @@ class Trunk(nn.Module):
                 cross_attn_compress_ratio=self.cross_attn_compress_ratio,
                 msa_tie_row_attn=self.msa_tie_row_attn,
                 context_parallel=self.context_parallel,
+                use_flash=self.use_flash,
                 dtype=self.dtype,
                 name=f"layer_{i}",
             )(x, m, pair_mask, msa_mask, deterministic)

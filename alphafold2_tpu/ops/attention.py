@@ -73,6 +73,7 @@ class Attention(nn.Module):
     dropout: float = 0.0
     compress_ratio: int = 1
     context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
+    use_flash: Optional[bool] = None  # None -> fused Pallas kernel on TPU
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -131,18 +132,29 @@ class Attention(nn.Module):
         q, k, v = split_heads(q), split_heads(k), split_heads(v)  # (B, n, h, dh)
         scale = dh**-0.5
 
-        # context-parallel path: exact attention with the sequence axis
-        # sharded over the mesh's sp axis (ring ppermute or Ulysses
-        # all-to-all — parallel/seq_parallel.py). Taken when a mesh is
-        # active and the call has no tied rows / KV compression; attention-
-        # weight dropout is a dense-path-only feature, so training with
-        # attn dropout > 0 falls through to the dense path.
-        if (
-            self.context_parallel is not None
-            and tie_dim is None
+        # Plain-softmax gate shared by the fused-kernel paths below: tied
+        # rows and compressed KV keep their bespoke dense computations, and
+        # attention-weight dropout needs materialized probabilities.
+        plain_softmax = (
+            tie_dim is None
             and self.compress_ratio == 1
             and (self.dropout == 0.0 or deterministic)
-        ):
+        )
+        kv_mask = context_mask
+        if kv_mask is None and not has_context:
+            kv_mask = mask
+
+        def heads_first(t):
+            return jnp.moveaxis(t, -2, 1)
+
+        def project_out(out):  # (B, H, n, dh) -> (B, n, dim)
+            out = jnp.moveaxis(out, 1, -2).reshape(*x.shape[:-1], inner)
+            return nn.Dense(self.dim, dtype=self.dtype, name="to_out")(out)
+
+        # context-parallel path: exact attention with the sequence axis
+        # sharded over the mesh's sp axis (ring ppermute or Ulysses
+        # all-to-all — parallel/seq_parallel.py), when a mesh is active.
+        if self.context_parallel is not None and plain_softmax:
             from alphafold2_tpu.parallel.seq_parallel import (
                 SEQ_AXIS_NAME,
                 sequence_parallel_attention,
@@ -151,19 +163,36 @@ class Attention(nn.Module):
 
             mesh = active_mesh()
             if mesh is not None and SEQ_AXIS_NAME in mesh.axis_names:
-                km = context_mask
-                if km is None and not has_context:
-                    km = mask
                 out = sequence_parallel_attention(
-                    jnp.moveaxis(q, -2, 1),
-                    jnp.moveaxis(k, -2, 1),
-                    jnp.moveaxis(v, -2, 1),
-                    mask=km,
+                    heads_first(q),
+                    heads_first(k),
+                    heads_first(v),
+                    mask=kv_mask,
                     mesh=mesh,
                     impl=self.context_parallel,
                 )  # (B, H, n, dh)
-                out = jnp.moveaxis(out, 1, -2).reshape(*x.shape[:-1], inner)
-                return nn.Dense(self.dim, dtype=self.dtype, name="to_out")(out)
+                return project_out(out)
+
+        # fused flash-attention path (TPU): the (n, n) attention matrix stays
+        # in VMEM instead of HBM.
+        use_flash = self.use_flash
+        if use_flash is None:
+            from alphafold2_tpu.ops.flash import flash_available
+
+            use_flash = flash_available()
+        if use_flash and plain_softmax:
+            from alphafold2_tpu.ops.flash import flash_attention
+
+            out = flash_attention(
+                heads_first(q),
+                heads_first(k),
+                heads_first(v),
+                q_mask=mask,
+                kv_mask=kv_mask,
+                sm_scale=scale,
+            )
+            if out is not None:
+                return project_out(out)
 
         if tie_dim is not None:
             # (B*R, n, h, d) -> (B, R, n, h, d); one attention matrix per (B, h)
@@ -225,6 +254,7 @@ class AxialAttention(nn.Module):
     seq_len: Optional[int] = None  # static max length for sparse block layout
     sparse_config: Optional[object] = None  # ops.sparse.BlockSparseConfig
     sparse_use_pallas: Optional[bool] = None  # None -> auto (Pallas on TPU)
+    use_flash: Optional[bool] = None  # dense path: fused kernel on TPU
     dtype: jnp.dtype = jnp.float32
 
     def _attn_cls(self, name):
@@ -247,6 +277,7 @@ class AxialAttention(nn.Module):
             heads=self.heads,
             dim_head=self.dim_head,
             dropout=self.dropout,
+            use_flash=self.use_flash,
             dtype=self.dtype,
             name=name,
         )

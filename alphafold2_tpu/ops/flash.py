@@ -1,0 +1,77 @@
+"""Fused flash-attention path for the dense axial/cross attention hot loops.
+
+The axial trunk's row/column passes materialize (B*N, H, N, N) logits in the
+naive formulation — at crop 384 that dominates HBM traffic. On TPU this
+module routes dense attention through the Pallas flash-attention kernels
+shipped with JAX (``jax.experimental.pallas.ops.tpu.flash_attention`` —
+fused QK^T/softmax/AV with full custom-VJP backward), so the N^2 attention
+matrix never hits HBM. Padding masks are expressed as segment ids (valid=1,
+pad=0: cross-segment pairs are masked inside the kernel).
+
+Used automatically by :class:`ops.attention.Attention` on TPU backends for
+the un-tied, un-compressed paths; everything falls back to the jnp dense
+path off-TPU or if the kernel rejects the shape (trace-time validation is
+caught and logged once).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_WARNED = set()
+
+
+def flash_available() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def flash_attention(
+    q: jnp.ndarray,  # (B, H, Nq, D)
+    k: jnp.ndarray,  # (B, H, Nk, D)
+    v: jnp.ndarray,
+    q_mask: Optional[jnp.ndarray] = None,  # (B, Nq) bool
+    kv_mask: Optional[jnp.ndarray] = None,  # (B, Nk) bool
+    sm_scale: float = 1.0,
+) -> Optional[jnp.ndarray]:
+    """Fused attention via the stock Pallas TPU kernel.
+
+    Returns None when the kernel cannot take this call (wrong backend or
+    shape constraints) — the caller falls back to the dense jnp path.
+    """
+    if not flash_available():
+        return None
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        SegmentIds,
+        flash_attention as _fa,
+    )
+
+    b, h, nq, d = q.shape
+    nk = k.shape[2]
+    segment_ids = None
+    if q_mask is not None or kv_mask is not None:
+        qs = (
+            q_mask.astype(jnp.int32)
+            if q_mask is not None
+            else jnp.ones((b, nq), jnp.int32)
+        )
+        ks = (
+            kv_mask.astype(jnp.int32)
+            if kv_mask is not None
+            else jnp.ones((b, nk), jnp.int32)
+        )
+        segment_ids = SegmentIds(q=qs, kv=ks)
+    try:
+        return _fa(q, k, v, segment_ids=segment_ids, sm_scale=sm_scale)
+    except (ValueError, NotImplementedError) as e:
+        key = str(e)[:80]
+        if key not in _WARNED:
+            _WARNED.add(key)
+            warnings.warn(
+                f"flash attention unavailable for shape q={q.shape} "
+                f"k={k.shape}: {e}; using dense attention"
+            )
+        return None

@@ -88,6 +88,7 @@ def build_model(cfg: Config) -> Alphafold2:
         cross_attn_compress_ratio=m.cross_attn_compress_ratio,
         msa_tie_row_attn=m.msa_tie_row_attn,
         context_parallel=m.context_parallel,
+        use_flash=m.flash_attention,
         template_attn_depth=m.template_attn_depth,
         dtype=jnp.bfloat16 if m.bfloat16 else jnp.float32,
     )

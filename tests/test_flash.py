@@ -1,0 +1,62 @@
+"""Flash-attention wrapper gating tests. The fused kernel itself is the
+stock JAX Pallas TPU op (compiled only on TPU backends; AF2TPU_TEST_TPU=1
+runs these paths on hardware) — what is tested hermetically is the
+gating/fallback contract the model relies on."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from alphafold2_tpu.ops.attention import Attention
+from alphafold2_tpu.ops.flash import flash_attention, flash_available
+
+
+def test_unavailable_off_tpu_returns_none():
+    assert not flash_available()  # suite runs on the CPU backend
+    q = jnp.ones((1, 2, 16, 8))
+    assert flash_attention(q, q, q) is None
+
+
+def test_attention_use_flash_true_falls_back_cleanly():
+    # explicit use_flash=True off-TPU: wrapper returns None, dense path runs,
+    # numbers identical to use_flash=False
+    x = jax.random.normal(jax.random.key(0), (2, 24, 32))
+    mask = jnp.ones((2, 24), bool).at[:, 20:].set(False)
+    a_flash = Attention(dim=32, heads=2, dim_head=16, use_flash=True)
+    a_dense = Attention(dim=32, heads=2, dim_head=16, use_flash=False)
+    params = a_dense.init(jax.random.key(1), x, mask=mask)
+    out_f = a_flash.apply(params, x, mask=mask)
+    out_d = a_dense.apply(params, x, mask=mask)
+    assert np.allclose(out_f, out_d, atol=1e-6)
+
+
+def test_flash_skipped_for_tied_rows_and_dropout(monkeypatch):
+    # tied rows and attn dropout are dense-path features; flash gating must
+    # not change their outputs
+    x = jax.random.normal(jax.random.key(2), (4, 8, 32))  # (B*R, n, d)
+    a = Attention(dim=32, heads=2, dim_head=16, use_flash=True)
+    b = Attention(dim=32, heads=2, dim_head=16, use_flash=False)
+    params = b.init(jax.random.key(3), x, tie_dim=2)
+    assert np.allclose(
+        a.apply(params, x, tie_dim=2), b.apply(params, x, tie_dim=2), atol=1e-6
+    )
+
+    # dropout gate: with attn dropout active (deterministic=False), the flash
+    # path must NOT be taken even when the kernel is "available" — attention-
+    # weight dropout needs materialized probabilities
+    from alphafold2_tpu.ops import flash as flash_mod
+
+    def boom(*a, **kw):  # pragma: no cover - must not be reached
+        raise AssertionError("flash path taken despite active attn dropout")
+
+    drop = Attention(dim=32, heads=2, dim_head=16, dropout=0.5, use_flash=None)
+    params_d = drop.init(jax.random.key(4), x)  # before the mock: init is deterministic
+    monkeypatch.setattr(flash_mod, "flash_available", lambda: True)
+    monkeypatch.setattr(flash_mod, "flash_attention", boom)
+    out = drop.apply(
+        params_d, x, deterministic=False, rngs={"dropout": jax.random.key(5)}
+    )
+    assert np.all(np.isfinite(out))
+    # ...and with deterministic=True the (mocked) flash path IS selected
+    with np.testing.assert_raises(AssertionError):
+        drop.apply(params_d, x, deterministic=True)
