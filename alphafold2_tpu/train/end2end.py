@@ -227,17 +227,20 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
     import time
 
     from alphafold2_tpu.data.pipeline import make_dataset
-    from alphafold2_tpu.parallel.sharding import make_mesh
     from alphafold2_tpu.train.loop import apply_features, device_put_batch
     from alphafold2_tpu.train.observe import MetricsLogger
 
     num_steps = num_steps or cfg.train.num_steps
     owns_dataset = dataset is None
-    dataset = dataset or make_dataset(cfg.data, seed=cfg.train.seed)
+    # per-host data seed: each process feeds its own global-batch slice
+    data_seed = cfg.train.seed + 7919 * jax.process_index()
+    dataset = dataset or make_dataset(cfg.data, seed=data_seed)
     data_iter = apply_features(iter(dataset), cfg)
     mesh = None
     if cfg.mesh.data_parallel * cfg.mesh.seq_parallel > 1:
-        mesh = make_mesh(cfg.mesh.data_parallel, cfg.mesh.seq_parallel)
+        from alphafold2_tpu.parallel.distributed import pod_mesh
+
+        mesh = pod_mesh(cfg.mesh.data_parallel, cfg.mesh.seq_parallel)
 
     model = End2EndModel(
         dim=cfg.model.dim, depth=cfg.model.depth, heads=cfg.model.heads,

@@ -212,6 +212,11 @@ def make_train_step(model: Alphafold2, mesh: Optional[Mesh] = None):
 def device_put_batch(batch: dict, mesh: Optional[Mesh] = None) -> dict:
     if mesh is None:
         return {k: jnp.asarray(v) for k, v in batch.items()}
+    if jax.process_count() > 1:
+        # multi-host: this process holds only its slice of the global batch
+        from alphafold2_tpu.parallel.distributed import global_batch
+
+        return global_batch(batch, mesh)
     sh = NamedSharding(mesh, P(DATA_AXIS))
     return {k: jax.device_put(jnp.asarray(v), sh) for k, v in batch.items()}
 
@@ -221,19 +226,24 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
     import time
 
     from alphafold2_tpu.data.pipeline import make_dataset
-    from alphafold2_tpu.parallel.sharding import make_mesh
     from alphafold2_tpu.train.checkpoint import CheckpointManager
     from alphafold2_tpu.train.observe import MetricsLogger, Profiler
 
     num_steps = num_steps or cfg.train.num_steps
     owns_dataset = dataset is None
-    dataset = dataset or make_dataset(cfg.data, seed=cfg.train.seed)
+    # fold the process index into the data seed: each host must feed a
+    # DIFFERENT slice of the global batch (global_batch() stitches them)
+    data_seed = cfg.train.seed + 7919 * jax.process_index()
+    dataset = dataset or make_dataset(cfg.data, seed=data_seed)
     data_iter = apply_features(iter(dataset), cfg)
 
     mesh = None
     n_mesh = cfg.mesh.data_parallel * cfg.mesh.seq_parallel
     if n_mesh > 1 or cfg.mesh.seq_parallel > 1:
-        mesh = make_mesh(cfg.mesh.data_parallel, cfg.mesh.seq_parallel)
+        # ICI/DCN-aware device ordering over the whole (multi-host) pod
+        from alphafold2_tpu.parallel.distributed import pod_mesh
+
+        mesh = pod_mesh(cfg.mesh.data_parallel, cfg.mesh.seq_parallel)
 
     model = build_model(cfg)
     sample = next(data_iter)

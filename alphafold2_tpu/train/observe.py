@@ -16,13 +16,22 @@ from typing import Optional, Tuple
 
 
 class MetricsLogger:
+    """JSONL + stdout metrics. In multi-host runs only process 0 logs —
+    otherwise every host appends to the same metrics.jsonl on shared
+    storage (duplicated and potentially interleaved records)."""
+
     def __init__(self, directory: Optional[str] = None, filename: str = "metrics.jsonl"):
+        import jax
+
+        self._enabled = jax.process_index() == 0
         self._path = None
-        if directory:
+        if directory and self._enabled:
             os.makedirs(directory, exist_ok=True)
             self._path = os.path.join(directory, filename)
 
     def log(self, step: int, metrics: dict) -> None:
+        if not self._enabled:
+            return
         record = {"step": step, "time": time.time(), **metrics}
         line = json.dumps(record)
         print(f"[step {step}] " + " ".join(

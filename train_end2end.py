@@ -16,6 +16,9 @@ from alphafold2_tpu.config import Config, DataConfig, ModelConfig, parse_cli
 
 def main(argv):
     alphafold2_tpu.setup_platform()  # AF2TPU_PLATFORM=cpu to force host
+    from alphafold2_tpu.parallel.distributed import initialize
+
+    initialize()  # multi-host process group (no-op single-process)
     base = Config(
         model=ModelConfig(dim=256, depth=1),
         data=DataConfig(crop_len=64),  # distogram runs over 3L atom tokens

@@ -15,6 +15,9 @@ from alphafold2_tpu.config import Config, ModelConfig, parse_cli
 
 def main(argv):
     alphafold2_tpu.setup_platform()  # AF2TPU_PLATFORM=cpu to force host
+    from alphafold2_tpu.parallel.distributed import initialize
+
+    initialize()  # multi-host process group (no-op single-process)
     base = Config(model=ModelConfig(dim=256, depth=1))  # train_pre.py:52-57
     cfg = parse_cli(argv, base)
     print("config:", cfg.to_json())
