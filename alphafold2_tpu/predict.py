@@ -1,0 +1,159 @@
+"""Inference: sequence (+MSA) -> distogram -> 3D structure -> PDB.
+
+The reference documents this flow only as README snippets + a notebook (run
+the model, softmax the distogram, ``center_distogram_torch``, ``MDScaling``,
+Kabsch against the truth); there is no runnable prediction entry point. This
+module is that entry point, jit-compiled end to end:
+
+- :func:`realize_structure` — distogram logits -> (coords, confidence
+  weights): softmax (the reference README feeds raw logits, a bug —
+  SURVEY.md S2.5), distogram centering, weighted MDS with per-element
+  chirality fix.
+- :func:`predict` — full pipeline on the end-to-end model (trunk ->
+  realization -> SE(3) refinement) returning atom14 coordinates plus a
+  :class:`PDBStructure` ready to write (utils/pdb.py).
+- CLI: ``python scripts/predict.py --seq ACDEFG... --out pred.pdb``
+  (optionally restoring a checkpoint from training).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from alphafold2_tpu import constants
+from alphafold2_tpu.config import Config
+from alphafold2_tpu.train.end2end import End2EndModel, init_end2end_state
+from alphafold2_tpu.utils.mds import mdscaling_backbone
+from alphafold2_tpu.utils.structure import center_distogram
+from alphafold2_tpu.utils import pdb as pdbio
+
+
+def realize_structure(
+    logits: jnp.ndarray,  # (B, N, N, K) distogram logits
+    iters: int = 200,
+    key: Optional[jax.Array] = None,
+    fix_mirror: bool = True,
+):
+    """Distogram logits -> (coords (B, 3, N), distances, weights).
+
+    The single realization implementation — End2EndModel calls this inside
+    the compiled train step too. Assumes the token stream is
+    (N, CA, C)-elongated when ``fix_mirror`` (the chirality test reads
+    backbone phi angles)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    distances, weights = center_distogram(probs)
+    coords, _ = mdscaling_backbone(
+        distances, weights=weights, iters=iters,
+        key=key if key is not None else jax.random.key(0),
+        fix_mirror=fix_mirror,
+    )
+    return coords, distances, weights
+
+
+@dataclasses.dataclass
+class Prediction:
+    atom14: np.ndarray  # (L, 14, 3) refined all-atom coordinates
+    backbone: np.ndarray  # (L, 3, 3) N/CA/C
+    weights: np.ndarray  # (3L, 3L) distogram confidence
+    distogram: np.ndarray  # (3L, 3L, K) logits
+
+    def to_pdb(self, seq: str, chain: str = "A") -> pdbio.PDBStructure:
+        return pdbio.backbone_to_pdb(seq, self.backbone, chain=chain)
+
+
+def encode_sequence(seq: str) -> np.ndarray:
+    """One-letter AA string -> (1, L) int tokens (AA_ALPHABET order)."""
+    idx = {a: i for i, a in enumerate(constants.AA_ALPHABET)}
+    return np.asarray([[idx.get(c.upper(), constants.AA_PAD_INDEX) for c in seq]],
+                      np.int32)
+
+
+def synthesize_msa(seq_tokens: np.ndarray, depth: int, seed: int = 0,
+                   rate: float = 0.15):
+    """Mutate the primary sequence into a stand-in MSA (as the data pipeline
+    does) for checkpoints trained with an MSA stream."""
+    rng = np.random.default_rng(seed)
+    b, l = seq_tokens.shape
+    msa = np.repeat(seq_tokens[:, None], depth, axis=1)
+    mut = rng.random((b, depth, l)) < rate
+    msa[mut] = rng.integers(0, 20, size=int(mut.sum()))
+    return msa
+
+
+def predict(
+    cfg: Config,
+    seq: str,
+    checkpoint_dir: Optional[str] = None,
+    msa_depth: Optional[int] = None,
+    seed: int = 0,
+) -> Prediction:
+    """Full prediction on the end-to-end model. Random init when no
+    checkpoint is given (useful for pipeline validation, not accuracy)."""
+    L = len(seq)
+    if 3 * L > cfg.model.max_seq_len:
+        raise ValueError(
+            f"sequence of {L} residues needs 3L={3 * L} positions but "
+            f"model.max_seq_len={cfg.model.max_seq_len}; raise it (positions "
+            "beyond the table would silently clamp to the last embedding)"
+        )
+    depth = msa_depth if msa_depth is not None else cfg.data.msa_depth
+    if depth > constants.MAX_NUM_MSA:
+        raise ValueError(
+            f"msa_depth={depth} exceeds MAX_NUM_MSA={constants.MAX_NUM_MSA} "
+            "(deeper rows would clamp the msa_num_pos_emb table)"
+        )
+    model = End2EndModel(
+        dim=cfg.model.dim, depth=cfg.model.depth, heads=cfg.model.heads,
+        dim_head=cfg.model.dim_head, max_seq_len=cfg.model.max_seq_len,
+        remat=cfg.model.remat, msa_tie_row_attn=cfg.model.msa_tie_row_attn,
+        context_parallel=cfg.model.context_parallel,
+        dtype=jnp.bfloat16 if cfg.model.bfloat16 else jnp.float32,
+    )
+    seq_tokens = encode_sequence(seq)
+    batch = {
+        "seq": seq_tokens,
+        "mask": np.ones((1, L), bool),
+        "msa": synthesize_msa(seq_tokens, depth, seed=seed),
+        "msa_mask": np.ones((1, depth, L), bool),
+    }
+    if checkpoint_dir:
+        from alphafold2_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(checkpoint_dir)
+        try:
+            # abstract params template via eval_shape (no throwaway forward
+            # pass); restore params only — inference must not depend on the
+            # training run's optimizer-state tree shape
+            template = jax.eval_shape(
+                lambda: init_end2end_state(cfg, model, batch)
+            )
+            params, _ = mgr.restore_params(template.params)
+        finally:
+            mgr.close()
+    else:
+        params = init_end2end_state(cfg, model, batch).params
+
+    @jax.jit
+    def fwd(params):
+        return model.apply(
+            params,
+            jnp.asarray(batch["seq"]),
+            jnp.asarray(batch["msa"]),
+            mask=jnp.asarray(batch["mask"]),
+            msa_mask=jnp.asarray(batch["msa_mask"]),
+            mds_key=jax.random.key(seed),
+        )
+
+    out = fwd(params)
+    atom14 = np.asarray(out["refined"])[0]  # (L, 14, 3)
+    return Prediction(
+        atom14=atom14,
+        backbone=atom14[:, :3],
+        weights=np.asarray(out["weights"])[0],
+        distogram=np.asarray(out["distogram"])[0],
+    )

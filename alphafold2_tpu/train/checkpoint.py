@@ -34,6 +34,26 @@ class CheckpointManager:
         )
         return restored, latest
 
+    def restore_params(self, params_template):
+        """Restore ONLY the model parameters from the latest checkpoint.
+
+        Inference doesn't need (and must not depend on) the optimizer
+        state — its tree shape varies with training config (e.g.
+        optax.MultiSteps wrapping under gradient accumulation). Partial
+        restore matches just the ``params`` subtree. ``params_template``
+        may be abstract (jax.eval_shape output).
+        """
+        latest = self._mgr.latest_step()
+        if latest is None:
+            raise FileNotFoundError(f"no checkpoint found under {self._dir!r}")
+        restored = self._mgr.restore(
+            latest,
+            args=ocp.args.PyTreeRestore(
+                item={"params": params_template}, partial_restore=True
+            ),
+        )
+        return restored["params"], latest
+
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 

@@ -39,9 +39,8 @@ from alphafold2_tpu.models.alphafold2 import Alphafold2
 from alphafold2_tpu.models.se3 import SE3Refiner
 from alphafold2_tpu.parallel.sharding import DATA_AXIS, use_mesh
 from alphafold2_tpu.train.loop import TrainState, build_optimizer
-from alphafold2_tpu.utils.mds import mdscaling_backbone
 from alphafold2_tpu.utils.metrics import kabsch
-from alphafold2_tpu.utils.structure import center_distogram, sidechain_container
+from alphafold2_tpu.utils.structure import sidechain_container
 
 
 def elongate(seq: jnp.ndarray, mask: jnp.ndarray):
@@ -89,13 +88,12 @@ class End2EndModel(nn.Module):
         )(seq3, msa, mask=mask3, msa_mask=msa_mask, embedds=embedds,
           deterministic=deterministic)
 
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        distances, weights = center_distogram(probs)
-        if mds_key is None:
-            mds_key = jax.random.key(0)
-        coords, _ = mdscaling_backbone(
-            distances, weights=weights, iters=self.mds_iters, key=mds_key
-        )  # (B, 3, 3L)
+        from alphafold2_tpu.predict import realize_structure
+
+        coords, distances, weights = realize_structure(
+            logits, iters=self.mds_iters,
+            key=mds_key if mds_key is not None else jax.random.key(0),
+        )  # coords (B, 3, 3L)
 
         backbone = jnp.swapaxes(coords, -1, -2)  # (B, 3L, 3)
         proto = sidechain_container(backbone, place_oxygen=True)  # (B, L, 14, 3)
@@ -252,12 +250,23 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
     sample = next(data_iter)
     state = init_end2end_state(cfg, model, sample)
     step_fn = make_end2end_step(model, mesh)
+
+    ckpt = None
+    start_step = 0
+    if cfg.train.checkpoint_dir:
+        from alphafold2_tpu.train.checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(
+            cfg.train.checkpoint_dir, keep=cfg.train.keep_checkpoints
+        )
+        state, start_step = ckpt.maybe_restore(state)
+
     logger = MetricsLogger(cfg.train.checkpoint_dir)
     rng = jax.random.key(cfg.train.seed + 1)
 
     batch = device_put_batch(sample, mesh)
     t0 = time.perf_counter()
-    for i in range(num_steps):
+    for i in range(start_step, num_steps):
         rng, r = jax.random.split(rng)
         state, metrics = step_fn(state, batch, r)
         if (i + 1) % cfg.train.log_every == 0 or i == 0:
@@ -267,7 +276,13 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
             )
             t0 = time.perf_counter()
             logger.log(i, m)
+        if ckpt is not None and (i + 1) % cfg.train.checkpoint_every == 0:
+            ckpt.save(i + 1, state)
         batch = device_put_batch(next(data_iter), mesh)
+    if ckpt is not None:
+        ckpt.save(num_steps, state)
+        ckpt.wait()
+        ckpt.close()
     if owns_dataset and hasattr(dataset, "close"):
         dataset.close()
     return state
