@@ -34,6 +34,9 @@ class ModelConfig:
     context_parallel: Optional[str] = None
     # fused Pallas flash attention for dense paths: None = auto (on TPU)
     flash_attention: Optional[bool] = None
+    # compile the trunk as ONE scanned layer with stacked params (compile
+    # time independent of depth); needs homogeneous layers
+    scan_layers: bool = False
     template_attn_depth: int = 2
     bfloat16: bool = True  # compute dtype on TPU
 

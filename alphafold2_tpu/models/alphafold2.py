@@ -122,6 +122,7 @@ class Alphafold2(nn.Module):
     msa_tie_row_attn: bool = False
     context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
     use_flash: Optional[bool] = None  # fused dense attention kernel on TPU
+    scan_layers: bool = False  # roll the trunk depth loop into lax.scan
     template_attn_depth: int = 2
     use_se3_template_embedder: bool = True
     dtype: jnp.dtype = jnp.float32
@@ -258,6 +259,7 @@ class Alphafold2(nn.Module):
             context_parallel=self.context_parallel,
             use_flash=self.use_flash,
             remat=self.remat,
+            scan_layers=self.scan_layers,
             dtype=dt,
             name="trunk",
         )(x, m, pair_mask=pair_mask, msa_mask=m_mask, deterministic=deterministic)

@@ -155,9 +155,42 @@ class TrunkLayer(nn.Module):
         return x, m
 
 
+class _ScanBody(nn.Module):
+    """nn.scan body: carries (x, m) through one TrunkLayer; masks ride in
+    as broadcast (loop-invariant) scan inputs."""
+
+    layer_kwargs: dict
+    deterministic: bool
+    remat: bool
+
+    @nn.compact
+    def __call__(self, carry, pair_mask, msa_mask):
+        x, m = carry
+        layer_cls = TrunkLayer
+        if self.remat:
+            # prevent_cse=False: the CSE-prevention barriers jax.checkpoint
+            # inserts by default are unnecessary (and costly) inside scan
+            layer_cls = nn.remat(
+                TrunkLayer, static_argnums=(5,), prevent_cse=False
+            )
+        x, m = layer_cls(**self.layer_kwargs, name="layer")(
+            x, m, pair_mask, msa_mask, self.deterministic
+        )
+        return (x, m), ()
+
+
 class Trunk(nn.Module):
     """Stack of TrunkLayers; ``remat=True`` checkpoints each layer (the
-    TPU-native replacement for the reference's reversible engine)."""
+    TPU-native replacement for the reference's reversible engine).
+
+    ``scan_layers=True`` rolls the depth loop into one ``lax.scan`` over a
+    single layer with stacked parameters: the trunk is traced/compiled ONCE
+    regardless of depth (compile time and program size stop growing with
+    depth — the TPU-first answer to deep trunks). Requires homogeneous
+    layers (a per-layer ``sparse_self_attn`` tuple needs the python loop).
+    Parameter trees differ between the two modes (stacked vs layer_i), so
+    checkpoints are not interchangeable across the flag.
+    """
 
     dim: int
     depth: int = 6
@@ -174,7 +207,26 @@ class Trunk(nn.Module):
     context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
     use_flash: Optional[bool] = None  # fused dense attention on TPU
     remat: bool = False
+    scan_layers: bool = False
     dtype: jnp.dtype = jnp.float32
+
+    def _layer_kwargs(self, sparse: bool) -> dict:
+        return dict(
+            dim=self.dim,
+            heads=self.heads,
+            dim_head=self.dim_head,
+            attn_dropout=self.attn_dropout,
+            ff_dropout=self.ff_dropout,
+            sparse_attn=sparse,
+            seq_len=self.seq_len,
+            sparse_config=self.sparse_config,
+            sparse_use_pallas=self.sparse_use_pallas,
+            cross_attn_compress_ratio=self.cross_attn_compress_ratio,
+            msa_tie_row_attn=self.msa_tie_row_attn,
+            context_parallel=self.context_parallel,
+            use_flash=self.use_flash,
+            dtype=self.dtype,
+        )
 
     @nn.compact
     def __call__(
@@ -185,26 +237,32 @@ class Trunk(nn.Module):
             sparse_flags = (sparse_flags,) * self.depth
         assert len(sparse_flags) == self.depth
 
+        if self.scan_layers:
+            assert len(set(sparse_flags)) <= 1, (
+                "scan_layers needs homogeneous layers; per-layer "
+                f"sparse_self_attn={sparse_flags} requires the python loop"
+            )
+            scanned = nn.scan(
+                _ScanBody,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast, nn.broadcast),
+                length=self.depth,
+            )(
+                layer_kwargs=self._layer_kwargs(sparse_flags[0]),
+                deterministic=deterministic,
+                remat=self.remat,
+                name="scan",
+            )
+            (x, m), _ = scanned((x, m), pair_mask, msa_mask)
+            return x, m
+
         layer_cls = TrunkLayer
         if self.remat:
             layer_cls = nn.remat(TrunkLayer, static_argnums=(5,))
 
         for i, sparse in enumerate(sparse_flags):
             x, m = layer_cls(
-                dim=self.dim,
-                heads=self.heads,
-                dim_head=self.dim_head,
-                attn_dropout=self.attn_dropout,
-                ff_dropout=self.ff_dropout,
-                sparse_attn=sparse,
-                seq_len=self.seq_len,
-                sparse_config=self.sparse_config,
-                sparse_use_pallas=self.sparse_use_pallas,
-                cross_attn_compress_ratio=self.cross_attn_compress_ratio,
-                msa_tie_row_attn=self.msa_tie_row_attn,
-                context_parallel=self.context_parallel,
-                use_flash=self.use_flash,
-                dtype=self.dtype,
-                name=f"layer_{i}",
+                **self._layer_kwargs(sparse), name=f"layer_{i}"
             )(x, m, pair_mask, msa_mask, deterministic)
         return x, m

@@ -89,6 +89,7 @@ def build_model(cfg: Config) -> Alphafold2:
         msa_tie_row_attn=m.msa_tie_row_attn,
         context_parallel=m.context_parallel,
         use_flash=m.flash_attention,
+        scan_layers=m.scan_layers,
         template_attn_depth=m.template_attn_depth,
         dtype=jnp.bfloat16 if m.bfloat16 else jnp.float32,
     )

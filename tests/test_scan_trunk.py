@@ -1,0 +1,102 @@
+"""Scan-over-layers trunk tests: the scanned trunk must be the same network
+as the python-loop trunk (outputs equal under stacked params), compose with
+remat, and reject heterogeneous per-layer configs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.models import Alphafold2
+
+
+KW = dict(dim=32, depth=3, heads=2, dim_head=16, max_seq_len=64)
+
+
+def _inputs():
+    k = jax.random.key(0)
+    seq = jax.random.randint(jax.random.fold_in(k, 1), (1, 8), 0, 21)
+    msa = jax.random.randint(jax.random.fold_in(k, 2), (1, 2, 8), 0, 21)
+    mask = jnp.ones((1, 8), bool)
+    msa_mask = jnp.ones((1, 2, 8), bool)
+    return seq, msa, mask, msa_mask
+
+
+def _stack_loop_params_into_scan(loop_params, scan_params, depth):
+    """Map layer_0..layer_{d-1} subtrees onto the scanned (stacked) tree."""
+    lp = loop_params["params"]["trunk"]
+    stacked = jax.tree.map(
+        lambda *leaves: jnp.stack(leaves),
+        *[lp[f"layer_{i}"] for i in range(depth)],
+    )
+    out = jax.tree.map(lambda x: x, scan_params)  # deep copy of structure
+    out["params"]["trunk"]["scan"]["layer"] = stacked
+    # everything outside the trunk is shared verbatim
+    for k, v in loop_params["params"].items():
+        if k != "trunk":
+            out["params"][k] = v
+    return out
+
+
+def test_scan_equals_loop_with_stacked_params():
+    seq, msa, mask, msa_mask = _inputs()
+    loop_model = Alphafold2(scan_layers=False, **KW)
+    scan_model = Alphafold2(scan_layers=True, **KW)
+    loop_params = loop_model.init(jax.random.key(3), seq, msa, mask=mask,
+                                  msa_mask=msa_mask)
+    scan_params = scan_model.init(jax.random.key(3), seq, msa, mask=mask,
+                                  msa_mask=msa_mask)
+    mapped = _stack_loop_params_into_scan(loop_params, scan_params, KW["depth"])
+    out_loop = loop_model.apply(loop_params, seq, msa, mask=mask,
+                                msa_mask=msa_mask)
+    out_scan = scan_model.apply(mapped, seq, msa, mask=mask, msa_mask=msa_mask)
+    assert np.allclose(out_loop, out_scan, atol=1e-5), (
+        np.abs(np.asarray(out_loop - out_scan)).max()
+    )
+    # same parameter count
+    n_loop = sum(x.size for x in jax.tree.leaves(loop_params))
+    n_scan = sum(x.size for x in jax.tree.leaves(scan_params))
+    assert n_loop == n_scan
+
+
+def test_scan_with_remat_grads_match():
+    seq, msa, mask, msa_mask = _inputs()
+    base = Alphafold2(scan_layers=True, remat=False, **KW)
+    remat = Alphafold2(scan_layers=True, remat=True, **KW)
+    params = base.init(jax.random.key(4), seq, msa, mask=mask, msa_mask=msa_mask)
+
+    def loss(model, p):
+        return jnp.sum(
+            model.apply(p, seq, msa, mask=mask, msa_mask=msa_mask) ** 2
+        )
+
+    g1 = jax.grad(lambda p: loss(base, p))(params)
+    g2 = jax.grad(lambda p: loss(remat, p))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        assert np.allclose(a, b, atol=1e-4)
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_scan_dropout_rng_plumbing(remat):
+    # the scan-lifted dropout rng path (split_rngs + remat-wrapped layer)
+    # must run and actually drop (stochastic across keys)
+    seq, msa, mask, msa_mask = _inputs()
+    model = Alphafold2(scan_layers=True, remat=remat, attn_dropout=0.3,
+                       ff_dropout=0.3, **KW)
+    params = model.init(jax.random.key(6), seq, msa, mask=mask,
+                        msa_mask=msa_mask)
+    outs = [
+        model.apply(params, seq, msa, mask=mask, msa_mask=msa_mask,
+                    deterministic=False, rngs={"dropout": jax.random.key(s)})
+        for s in (0, 1)
+    ]
+    assert np.all(np.isfinite(outs[0]))
+    assert not np.allclose(outs[0], outs[1])  # different keys -> different drops
+
+
+def test_scan_rejects_heterogeneous_sparse():
+    seq, msa, mask, msa_mask = _inputs()
+    model = Alphafold2(scan_layers=True, sparse_self_attn=(True, False, True),
+                       **KW)
+    with pytest.raises(AssertionError, match="homogeneous"):
+        model.init(jax.random.key(5), seq, msa, mask=mask, msa_mask=msa_mask)
