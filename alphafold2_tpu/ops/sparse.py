@@ -153,6 +153,47 @@ def block_sparse_attention(
     return out.reshape(b, h, n, d)
 
 
+def block_sparse_attention_pallas(
+    q, k, v, layout: np.ndarray, block_size: int, mask=None
+):
+    """Pallas forward + differentiable backward.
+
+    ``pallas_call`` kernels carry no autodiff rule, so training through the
+    raw kernel raises NotImplementedError. This wrapper pairs the fused
+    Pallas forward with a backward derived from the gather-based jnp oracle
+    (numerically identical restriction of dense attention), recomputed from
+    the saved q/k/v — flash-style: nothing quadratic is saved between
+    passes.
+    """
+
+    @jax.custom_vjp
+    def f(q, k, v, mask):
+        from alphafold2_tpu.ops.pallas.block_sparse import (
+            pallas_block_sparse_attention,
+        )
+
+        return pallas_block_sparse_attention(
+            q, k, v, layout, block_size, mask=mask
+        )
+
+    def fwd(q, k, v, mask):
+        return f(q, k, v, mask), (q, k, v, mask)
+
+    def bwd(res, g):
+        q, k, v, mask = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: block_sparse_attention(
+                q_, k_, v_, layout, block_size, mask=mask
+            ),
+            q, k, v,
+        )
+        dq, dk, dv = vjp(g)
+        return dq, dk, dv, None
+
+    f.defvjp(fwd, bwd)
+    return f(q, k, v, mask)
+
+
 class SparseAttention(nn.Module):
     """Block-sparse multi-head self-attention (drop-in for Attention).
 
@@ -215,11 +256,7 @@ class SparseAttention(nn.Module):
         if use_pallas is None:
             use_pallas = jax.default_backend() == "tpu"
         if use_pallas:
-            from alphafold2_tpu.ops.pallas.block_sparse import (
-                pallas_block_sparse_attention,
-            )
-
-            out = pallas_block_sparse_attention(q, k, v, layout, bs, mask=mask)
+            out = block_sparse_attention_pallas(q, k, v, layout, bs, mask=mask)
         else:
             out = block_sparse_attention(q, k, v, layout, bs, mask=mask)
 

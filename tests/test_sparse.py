@@ -92,6 +92,28 @@ def test_pallas_dense_layout_oracle():
     assert np.allclose(out, ref, atol=1e-4)
 
 
+def test_pallas_path_is_differentiable_and_grads_match_jnp():
+    # training goes through value_and_grad: the Pallas forward must carry a
+    # VJP (raw pallas_call kernels have none) and its gradients must equal
+    # the jnp oracle's
+    kw = dict(dim=32, heads=2, dim_head=16, seq_len=64,
+              config=BlockSparseConfig(block_size=16, num_random_blocks=1))
+    x = jax.random.normal(jax.random.key(10), (1, 32, 32))
+    mask = jnp.ones((1, 32), dtype=bool).at[:, 28:].set(False)
+    m_jnp = SparseAttention(use_pallas=False, **kw)
+    m_pal = SparseAttention(use_pallas=True, **kw)  # interpret mode on CPU
+    params = m_jnp.init(jax.random.key(11), x, mask=mask)
+
+    def loss(model, p):
+        return jnp.sum(model.apply(p, x, mask=mask) ** 2)
+
+    l1, g1 = jax.value_and_grad(lambda p: loss(m_jnp, p))(params)
+    l2, g2 = jax.value_and_grad(lambda p: loss(m_pal, p))(params)
+    assert np.isclose(float(l1), float(l2), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        assert np.allclose(a, b, atol=1e-4), np.abs(np.asarray(a - b)).max()
+
+
 def test_sparse_module_pads_and_preserves_mask():
     # n=40 not a block multiple: module pads to 48 and composes the caller
     # mask instead of overwriting it (the reference clobbers it,
