@@ -264,6 +264,38 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
     profiler = Profiler(cfg.train.profile_dir, cfg.train.profile_steps)
     rng = jax.random.key(cfg.train.seed + 1)
 
+    # preemption safety (SURVEY.md S5.3 — the reference has no failure
+    # handling at all): on SIGTERM, finish the in-flight step, checkpoint,
+    # and exit cleanly; the next run resumes from maybe_restore above.
+    import signal
+
+    stop = {"requested": False}
+    prev_handler = None
+    if ckpt is not None:
+        def _on_sigterm(signum, frame):
+            stop["requested"] = True
+
+        try:
+            prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:  # not on the main thread
+            prev_handler = None
+
+    def stop_agreed() -> bool:
+        # multi-host: the stop decision must be COLLECTIVE — hosts receive
+        # SIGTERM at slightly different times, and a host breaking out
+        # early while others run the next step's collectives deadlocks the
+        # pod. One tiny bool allgather per step synchronizes the decision.
+        if jax.process_count() > 1:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            return bool(
+                multihost_utils.process_allgather(
+                    np.asarray(stop["requested"])
+                ).any()
+            )
+        return stop["requested"]
+
     batch = device_put_batch(sample, mesh)
     t0 = time.perf_counter()
     for i in range(start_step, num_steps):
@@ -282,9 +314,18 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
             cb(i, state, metrics)
         if ckpt is not None and (i + 1) % cfg.train.checkpoint_every == 0:
             ckpt.save(i + 1, state)
+        if ckpt is not None and stop_agreed():
+            stop["requested"] = True
+            logger.log(i, {"preempted": 1.0})
+            if ckpt.latest_step() != i + 1:
+                ckpt.save(i + 1, state)
+            break
         batch = device_put_batch(next(data_iter), mesh)
+    if prev_handler is not None:
+        signal.signal(signal.SIGTERM, prev_handler)
     if ckpt is not None:
-        ckpt.save(num_steps, state)
+        if not stop["requested"] and ckpt.latest_step() != num_steps:
+            ckpt.save(num_steps, state)
         ckpt.wait()
     if owns_dataset and hasattr(dataset, "close"):
         dataset.close()  # shut down native prefetch workers

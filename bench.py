@@ -15,17 +15,28 @@ import json
 import os
 import time
 
+import alphafold2_tpu
+
+alphafold2_tpu.setup_platform()  # AF2TPU_PLATFORM=cpu for host-side smokes
+
 import jax
 import jax.numpy as jnp
 
-CROP = 256
-MSA_DEPTH = 16
-MSA_LEN = 256
-DIM = 256
-DEPTH = 2
-BATCH = 1
-WARMUP = 3
-ITERS = 10
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+# flagship config; AF2TPU_BENCH_* env overrides allow small smoke runs on
+# hosts without an accelerator (the driver runs the defaults on TPU)
+CROP = _env_int("AF2TPU_BENCH_CROP", 256)
+MSA_DEPTH = _env_int("AF2TPU_BENCH_MSA_DEPTH", 16)
+MSA_LEN = _env_int("AF2TPU_BENCH_MSA_LEN", 256)
+DIM = _env_int("AF2TPU_BENCH_DIM", 256)
+DEPTH = _env_int("AF2TPU_BENCH_DEPTH", 2)
+BATCH = _env_int("AF2TPU_BENCH_BATCH", 1)
+WARMUP = _env_int("AF2TPU_BENCH_WARMUP", 3)
+ITERS = _env_int("AF2TPU_BENCH_ITERS", 10)
 
 
 def main():
@@ -60,7 +71,7 @@ def main():
     for i in range(WARMUP):
         rng, r = jax.random.split(rng)
         state, metrics = step(state, dev_batch, r)
-    jax.block_until_ready(metrics["loss"])
+    jax.block_until_ready(state.params)
 
     t0 = time.perf_counter()
     for i in range(ITERS):
@@ -72,8 +83,11 @@ def main():
     pairs_per_sec = BATCH * CROP * CROP / dt
 
     baseline_path = os.path.join(os.path.dirname(__file__), "bench_baseline.json")
+    overridden = any(k.startswith("AF2TPU_BENCH_") for k in os.environ)
     vs_baseline = 1.0
-    if os.path.exists(baseline_path):
+    if os.path.exists(baseline_path) and not overridden:
+        # the committed baseline is the flagship config on TPU; comparing a
+        # size-overridden smoke run against it would be meaningless
         with open(baseline_path) as f:
             base = json.load(f)
         if base.get("value"):

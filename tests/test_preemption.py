@@ -1,0 +1,83 @@
+"""Preemption safety: SIGTERM mid-training checkpoints and exits cleanly;
+a relaunch resumes from the saved step (SURVEY.md S5.3 — elastic-recovery
+capability the reference lacks entirely). Driven as a real subprocess so
+the signal path is the production one."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ARGS = [
+    "train.num_steps=100000", "train.log_every=1", "train.checkpoint_every=50000",
+    "data.crop_len=12", "data.min_len_filter=8", "data.msa_len=8",
+    "data.msa_depth=2", "model.dim=32", "model.depth=1", "model.heads=2",
+    "model.dim_head=16", "model.max_seq_len=24", "model.bfloat16=false",
+    "train.gradient_accumulate_every=1",
+]
+
+
+def _launch(ckpt_dir, extra=()):
+    env = dict(os.environ, AF2TPU_PLATFORM="cpu")
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "train_pre.py"),
+         f"train.checkpoint_dir={ckpt_dir}", *ARGS, *extra],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _wait_for_steps(proc, metrics_path, n, timeout=240):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(metrics_path):
+            with open(metrics_path) as f:
+                lines = f.readlines()
+            if len(lines) >= n:
+                return [json.loads(l) for l in lines]
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"trainer exited early: {proc.stdout.read()[-2000:]}"
+            )
+        time.sleep(0.5)
+    raise AssertionError("timed out waiting for training steps")
+
+
+def test_sigterm_checkpoints_and_resumes(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    metrics = os.path.join(ckpt, "metrics.jsonl")
+
+    proc = _launch(ckpt)
+    try:
+        _wait_for_steps(proc, metrics, 3)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, out[-2000:]
+    assert "preempted" in out
+
+    steps = [d for d in os.listdir(ckpt) if d.isdigit()]
+    assert steps, f"no checkpoint written: {os.listdir(ckpt)}"
+    saved = max(int(s) for s in steps)
+    assert 0 < saved < 100000
+
+    # relaunch: must resume from the saved step, not step 0
+    proc2 = _launch(ckpt)
+    try:
+        records = _wait_for_steps(proc2, metrics, len(open(metrics).readlines()) + 1)
+    finally:
+        proc2.send_signal(signal.SIGTERM)
+        try:
+            proc2.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            proc2.kill()
+    resumed_steps = [r["step"] for r in records if "loss" in r]
+    assert any(s >= saved for s in resumed_steps), (saved, resumed_steps[-5:])
