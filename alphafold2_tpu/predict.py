@@ -38,15 +38,21 @@ def realize_structure(
     iters: int = 200,
     key: Optional[jax.Array] = None,
     fix_mirror: bool = True,
+    mask: Optional[jnp.ndarray] = None,  # (B, N) bool token validity
 ):
     """Distogram logits -> (coords (B, 3, N), distances, weights).
 
     The single realization implementation — End2EndModel calls this inside
     the compiled train step too. Assumes the token stream is
     (N, CA, C)-elongated when ``fix_mirror`` (the chirality test reads
-    backbone phi angles)."""
+    backbone phi angles). ``mask`` zeroes the MDS weights of pairs touching
+    padded positions so padding's arbitrary pseudo-distances cannot distort
+    the valid region."""
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     distances, weights = center_distogram(probs)
+    if mask is not None:
+        pair_valid = mask[:, :, None] & mask[:, None, :]
+        weights = weights * pair_valid
     coords, _ = mdscaling_backbone(
         distances, weights=weights, iters=iters,
         key=key if key is not None else jax.random.key(0),

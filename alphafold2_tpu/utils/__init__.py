@@ -14,9 +14,11 @@ from alphafold2_tpu.utils.metrics import (
     RMSD,
     TMscore,
     calc_phis,
+    distogram_lddt,
     gdt,
     get_dihedral,
     kabsch,
+    lddt,
     rmsd,
     tmscore,
 )

@@ -133,6 +133,75 @@ def tmscore(X: jnp.ndarray, Y: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean(1.0 / (1.0 + (dist / d0) ** 2), axis=-1)
 
 
+def _lddt_from_distances(
+    d_pred: jnp.ndarray,  # (..., N, N)
+    d_true: jnp.ndarray,
+    mask: jnp.ndarray | None,
+    cutoff: float,
+    thresholds,
+    exclude_neighbors: int = 0,
+) -> jnp.ndarray:
+    """Shared lDDT scoring core over precomputed distance matrices."""
+    n = d_true.shape[-1]
+    eye = jnp.eye(n, dtype=bool)
+    incl = (d_true < cutoff) & ~eye
+    if exclude_neighbors > 0:
+        idx = jnp.arange(n)
+        near = jnp.abs(idx[:, None] - idx[None, :]) <= exclude_neighbors
+        incl = incl & ~near
+    if mask is not None:
+        incl = incl & mask[..., :, None] & mask[..., None, :]
+    delta = jnp.abs(d_true - d_pred)
+    th = jnp.asarray(thresholds, dtype=delta.dtype)
+    ok = (delta[..., None] < th).astype(delta.dtype).mean(-1)  # (..., N, N)
+    denom = jnp.maximum(incl.sum((-1, -2)), 1)
+    return jnp.sum(ok * incl, axis=(-1, -2)) / denom
+
+
+def lddt(
+    pred_coords: jnp.ndarray,  # (..., N, 3)
+    true_coords: jnp.ndarray,  # (..., N, 3)
+    mask: jnp.ndarray | None = None,  # (..., N) bool
+    cutoff: float = 15.0,
+    thresholds=(0.5, 1.0, 2.0, 4.0),
+    exclude_neighbors: int = 0,
+) -> jnp.ndarray:
+    """Local Distance Difference Test over CA coordinates -> (...,) in [0, 1].
+
+    Superposition-free local quality score (Mariani et al. 2013): for every
+    pair within ``cutoff`` A in the TRUE structure, the fraction of pairs
+    whose predicted distance deviates by less than each threshold, averaged
+    over thresholds. This is the BASELINE.md quality bar ("distogram lDDT");
+    the reference defines no lDDT anywhere — only RMSD/GDT/TM.
+    """
+    from alphafold2_tpu.utils.structure import cdist
+
+    return _lddt_from_distances(
+        cdist(pred_coords, pred_coords), cdist(true_coords, true_coords),
+        mask, cutoff, thresholds, exclude_neighbors,
+    )
+
+
+def distogram_lddt(
+    logits: jnp.ndarray,  # (..., N, N, K) distogram logits
+    true_coords: jnp.ndarray,  # (..., N, 3)
+    mask: jnp.ndarray | None = None,
+    cutoff: float = 15.0,
+    thresholds=(0.5, 1.0, 2.0, 4.0),
+) -> jnp.ndarray:
+    """lDDT of the distogram's expected distances against true geometry.
+
+    Evaluates the distogram directly (no MDS realization): predicted
+    distance = probability-weighted bin centers. The BASELINE.md metric.
+    """
+    from alphafold2_tpu.utils.structure import center_distogram, cdist
+
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    d_pred, _ = center_distogram(probs)
+    d_true = cdist(true_coords, true_coords)
+    return _lddt_from_distances(d_pred, d_true, mask, cutoff, thresholds)
+
+
 # ---------------------------------------------------------------------------
 # Public API wrappers: accept (D, N) or (B, D, N), numpy or jax arrays.
 # Names match the reference's exports (utils.py:707-770).

@@ -1,0 +1,71 @@
+"""lDDT metric tests: perfect/degraded predictions, superposition
+invariance (the property that distinguishes lDDT from RMSD), masking, and
+the distogram variant against a sharp distogram oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from alphafold2_tpu.utils import distogram_lddt, lddt
+
+
+def _cloud(n=32, seed=0):
+    return np.random.default_rng(seed).uniform(-8, 8, size=(n, 3)).astype(
+        np.float32
+    )
+
+
+def test_perfect_prediction_scores_one():
+    x = _cloud()
+    assert np.isclose(float(lddt(x[None], x[None])[0]), 1.0)
+
+
+def test_degrades_with_noise_and_orders_correctly():
+    x = _cloud()
+    rng = np.random.default_rng(1)
+    scores = []
+    for s in (0.1, 0.5, 2.0):
+        noisy = x + rng.normal(scale=s, size=x.shape).astype(np.float32)
+        scores.append(float(lddt(noisy[None], x[None])[0]))
+    assert scores[0] > scores[1] > scores[2], scores
+    assert scores[0] > 0.9 and scores[2] < 0.6
+
+
+def test_superposition_free():
+    # a rigidly moved prediction scores exactly 1.0 with NO alignment step
+    x = _cloud()
+    theta = 1.1
+    rot = np.asarray(
+        [[np.cos(theta), -np.sin(theta), 0],
+         [np.sin(theta), np.cos(theta), 0], [0, 0, 1.0]], np.float32)
+    moved = x @ rot.T + np.asarray([10.0, -4.0, 2.0], np.float32)
+    assert np.isclose(float(lddt(moved[None], x[None])[0]), 1.0, atol=1e-5)
+
+
+def test_mask_excludes_positions():
+    x = _cloud()
+    bad = x.copy()
+    bad[-8:] += 50.0  # ruin the tail
+    mask = np.ones(len(x), bool)
+    full = float(lddt(bad[None], x[None], mask=mask[None])[0])
+    mask[-8:] = False
+    masked = float(lddt(bad[None], x[None], mask=mask[None])[0])
+    assert masked > full
+    assert np.isclose(masked, 1.0, atol=1e-5)  # unmasked region is perfect
+
+
+def test_distogram_lddt_sharp_oracle():
+    from alphafold2_tpu.utils.structure import DISTANCE_THRESHOLDS, cdist
+
+    x = _cloud(24, seed=2)
+    dist = np.asarray(cdist(x[None], x[None]))[0]
+    centers = DISTANCE_THRESHOLDS - 0.25
+    bins = np.abs(dist[..., None] - centers[None, None]).argmin(-1)
+    sharp = jnp.asarray(
+        30.0 * (np.arange(37)[None, None] == bins[..., None]), jnp.float32
+    )[None]
+    uniform = jnp.zeros_like(sharp)
+    s_sharp = float(distogram_lddt(sharp, jnp.asarray(x)[None])[0])
+    s_unif = float(distogram_lddt(uniform, jnp.asarray(x)[None])[0])
+    assert s_sharp > 0.95, s_sharp
+    assert s_sharp > s_unif
