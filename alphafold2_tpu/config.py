@@ -55,7 +55,7 @@ class DataConfig:
     batch_size: int = 1
     max_len_filter: int = 250  # drop chains longer than this (train_pre.py:47)
     min_len_filter: int = 16
-    source: str = "synthetic"  # "synthetic" | "native" | "sidechainnet"
+    source: str = "synthetic"  # "synthetic" | "native" | "npz" | "sidechainnet"
     casp_version: int = 12
     thinning: int = 30
     data_dir: Optional[str] = None

@@ -46,6 +46,19 @@ def _smooth_walk(rng: np.random.Generator, n: int) -> np.ndarray:
     return (coords - coords.mean(0)).astype(np.float32)
 
 
+def _fill_msa(rng, seq_crop, msa_out, msa_mask_out, mutation_rate=0.15):
+    """Fill (M, NM) MSA rows by mutating the cropped primary sequence —
+    the one MSA-synthesis implementation shared by every data source."""
+    M, NM = msa_out.shape
+    msa_len = min(NM, len(seq_crop))
+    for m in range(M):
+        mut = rng.random(msa_len) < mutation_rate
+        row = np.asarray(seq_crop[:msa_len]).copy()
+        row[mut] = rng.integers(0, 20, size=int(mut.sum()))
+        msa_out[m, :msa_len] = row
+        msa_mask_out[m, :msa_len] = True
+
+
 def _synthesize_backbone(rng: np.random.Generator, ca: np.ndarray) -> np.ndarray:
     """Place N and C pseudo-atoms ~1.5A off each CA along the chain direction."""
     n = ca.shape[0]
@@ -87,14 +100,8 @@ class SyntheticDataset:
                 batch["mask"][b, :true_len] = True
                 batch["coords"][b, :true_len] = ca
                 batch["backbone"][b, : true_len * 3] = _synthesize_backbone(rng, ca)
-                msa_len = min(NM, true_len)
-                for m in range(M):
-                    mut = rng.random(msa_len) < 0.15
-                    row = seq[:msa_len].copy()
-                    row[mut] = rng.integers(0, 20, size=int(mut.sum()))
-                    batch["msa"][b, m, :msa_len] = row
-                    batch["msa"][b, m, msa_len:] = constants.AA_PAD_INDEX
-                    batch["msa_mask"][b, m, :msa_len] = True
+                batch["msa"][b, :, :] = constants.AA_PAD_INDEX
+                _fill_msa(rng, seq, batch["msa"][b], batch["msa_mask"][b])
             yield batch
 
 
@@ -157,14 +164,102 @@ class SidechainnetDataset:
                     out["coords"][i, :w] = coords[r, sl, 1]  # CA slot
                     bb = coords[r, sl, :3].reshape(w * 3, 3)
                     out["backbone"][i, : w * 3] = bb
+                    _fill_msa(rng, seqs[r, sl], out["msa"][i], out["msa_mask"][i])
                     msa_len = min(NM, w)
-                    for m in range(M):
-                        mut = rng.random(msa_len) < 0.15
-                        row = seqs[r, sl][:msa_len].copy()
-                        row[mut] = rng.integers(0, 20, size=int(mut.sum()))
-                        out["msa"][i, m, :msa_len] = row
-                        out["msa_mask"][i, m, :msa_len] = masks[r, sl][:msa_len]
+                    out["msa_mask"][i, :, :msa_len] &= masks[r, sl][:msa_len]
                 yield out
+
+
+class NpzShardDataset:
+    """Local real-data ingestion: a directory of ``.npz`` shards.
+
+    Each shard holds one chain: ``seq`` (L,) int tokens (AA_ALPHABET
+    order), ``coords`` (L, 3) CA positions (or (L, k>=3, 3) atom14-style,
+    slot 1 = CA, slots 0..2 = N/CA/C), optional ``msa`` (M, L) int. Chains
+    are length-filtered, cropped/padded to static shapes, cycled forever
+    with a seeded shuffle; MSAs absent from a shard are synthesized by
+    mutation like the other sources. ``scripts/import_pdbs.py`` converts a
+    directory of PDB files into this format using the built-in PDB codec.
+    """
+
+    def __init__(self, config: DataConfig, seed: int = 0):
+        import glob
+        import os
+
+        assert config.data_dir, "source='npz' needs data.data_dir"
+        self.config = config
+        self.seed = seed
+        self.paths = sorted(glob.glob(os.path.join(config.data_dir, "*.npz")))
+        if not self.paths:
+            raise FileNotFoundError(
+                f"no .npz shards under {config.data_dir!r}"
+            )
+
+    def __iter__(self) -> Iterator[dict]:
+        cfg = self.config
+        rng = np.random.default_rng(self.seed)
+        L, M, NM, B = cfg.crop_len, cfg.msa_depth, cfg.msa_len, cfg.batch_size
+        order = np.arange(len(self.paths))
+        buf = []
+        while True:
+            rng.shuffle(order)
+            accepted = 0
+            for idx in order:
+                with np.load(self.paths[idx]) as z:
+                    seq = np.asarray(z["seq"], np.int32)
+                    coords = np.asarray(z["coords"], np.float32)
+                    msa_full = (
+                        np.asarray(z["msa"], np.int32) if "msa" in z else None
+                    )
+                n = len(seq)
+                if n < max(4, cfg.min_len_filter) or n > cfg.max_len_filter:
+                    continue
+                accepted += 1
+                if coords.ndim == 3:  # (L, k, 3) atomic: slots 0..2 = N/CA/C
+                    backbone_atoms = coords[:, :3].reshape(-1, 3)
+                    ca = coords[:, 1]
+                else:  # CA-only shard: synthesize N/C pseudo-atoms so the
+                    # end2end structure loss has a real (nonzero) target
+                    ca = coords
+                    backbone_atoms = _synthesize_backbone(rng, ca)
+                start = 0 if n <= L else int(rng.integers(0, n - L + 1))
+                end = min(start + L, n)
+                w = end - start
+                item = {
+                    "seq": np.full(L, constants.AA_PAD_INDEX, np.int32),
+                    "msa": np.full((M, NM), constants.AA_PAD_INDEX, np.int32),
+                    "mask": np.zeros(L, bool),
+                    "msa_mask": np.zeros((M, NM), bool),
+                    "coords": np.zeros((L, 3), np.float32),
+                    "backbone": np.zeros((L * 3, 3), np.float32),
+                }
+                item["seq"][:w] = seq[start:end]
+                item["mask"][:w] = True
+                item["coords"][:w] = ca[start:end]
+                item["backbone"][: w * 3] = backbone_atoms[start * 3 : end * 3]
+                if msa_full is not None:
+                    msa_len = min(NM, w)
+                    rows = min(M, len(msa_full))
+                    item["msa"][:rows, :msa_len] = msa_full[
+                        :rows, start : start + msa_len
+                    ]
+                    item["msa_mask"][:rows, :msa_len] = True
+                    if rows < M:
+                        _fill_msa(rng, seq[start:end], item["msa"][rows:],
+                                  item["msa_mask"][rows:])
+                else:
+                    _fill_msa(rng, seq[start:end], item["msa"], item["msa_mask"])
+                buf.append(item)
+                if len(buf) == B:
+                    yield {
+                        k: np.stack([it[k] for it in buf]) for k in buf[0]
+                    }
+                    buf = []
+            if accepted == 0:
+                raise ValueError(
+                    f"no shard in {cfg.data_dir!r} passes the length filter "
+                    f"[{cfg.min_len_filter}, {cfg.max_len_filter}]"
+                )
 
 
 def make_dataset(config: DataConfig, seed: int = 0):
@@ -182,6 +277,8 @@ def make_dataset(config: DataConfig, seed: int = 0):
             "(make -C native); falling back to the numpy pipeline"
         )
         return SyntheticDataset(config, seed=seed)
+    if config.source == "npz":
+        return NpzShardDataset(config, seed=seed)
     if config.source == "sidechainnet":
         return SidechainnetDataset(config, seed=seed)
     raise ValueError(f"unknown data source {config.source!r}")

@@ -1,0 +1,118 @@
+"""Real-data ingestion tests: PDB directory -> npz shards -> training
+batches -> one train step. The full local-data loop the reference delegates
+to sidechainnet."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu import constants
+from alphafold2_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from alphafold2_tpu.data.pipeline import NpzShardDataset, make_dataset
+from alphafold2_tpu.data.pipeline import _smooth_walk
+from alphafold2_tpu.utils import pdb as pdbio
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_pdbs(d, n_files=3, length=20):
+    rng = np.random.default_rng(0)
+    os.makedirs(d, exist_ok=True)
+    for i in range(n_files):
+        ca = _smooth_walk(rng, length)
+        dvec = np.diff(ca, axis=0, prepend=ca[:1] - (ca[1:2] - ca[:1]))
+        dvec /= np.linalg.norm(dvec, axis=-1, keepdims=True) + 1e-9
+        bb = np.stack([ca - 1.46 * dvec, ca, ca + 1.52 * dvec], axis=1)
+        seq = "".join(
+            constants.AA_ALPHABET[t]
+            for t in rng.integers(0, 20, size=length)
+        )
+        pdbio.save_pdb(
+            pdbio.backbone_to_pdb(seq, bb.astype(np.float32)),
+            os.path.join(d, f"chain_{i}.pdb"),
+        )
+
+
+def test_import_pdbs_cli_and_train(tmp_path):
+    pdb_dir = str(tmp_path / "pdbs")
+    out_dir = str(tmp_path / "shards")
+    _write_pdbs(pdb_dir)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "import_pdbs.py"),
+         pdb_dir, out_dir],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "imported 3/3" in r.stdout
+
+    cfg = Config(
+        model=ModelConfig(dim=32, depth=1, heads=2, dim_head=16, max_seq_len=32,
+                          bfloat16=False),
+        data=DataConfig(crop_len=16, msa_depth=2, msa_len=16, batch_size=2,
+                        min_len_filter=8, source="npz", data_dir=out_dir),
+        train=TrainConfig(gradient_accumulate_every=1, warmup_steps=2),
+    )
+    ds = make_dataset(cfg.data, seed=0)
+    assert isinstance(ds, NpzShardDataset)
+    batch = next(iter(ds))
+    assert batch["seq"].shape == (2, 16)
+    assert batch["backbone"].shape == (2, 48, 3)
+    # backbone slot 1 of each residue == the CA coords array
+    bb = batch["backbone"].reshape(2, 16, 3, 3)
+    w = batch["mask"][0].sum()
+    assert np.allclose(bb[0, :w, 1], batch["coords"][0, :w], atol=1e-3)
+    # consecutive CA distances are protein-like (came from real geometry)
+    steps = np.linalg.norm(np.diff(batch["coords"][0][:w], axis=0), axis=-1)
+    assert np.allclose(steps, 3.8, atol=0.3)
+
+    import jax
+
+    from alphafold2_tpu.train.loop import (
+        build_model, device_put_batch, init_state, make_train_step,
+    )
+
+    model = build_model(cfg)
+    state = init_state(cfg, model, batch)
+    step = make_train_step(model)
+    state, metrics = step(state, device_put_batch(batch), jax.random.key(0))
+    assert np.isfinite(float(metrics["loss"]))
+    assert bool(metrics["grads_ok"])
+
+
+def test_npz_dataset_validates(tmp_path):
+    cfg = DataConfig(source="npz", data_dir=str(tmp_path / "empty"))
+    with pytest.raises(FileNotFoundError, match="no .npz shards"):
+        NpzShardDataset(cfg)
+    with pytest.raises(AssertionError, match="data_dir"):
+        NpzShardDataset(DataConfig(source="npz", data_dir=None))
+
+
+def test_npz_dataset_length_filter_and_crop(tmp_path):
+    d = str(tmp_path / "shards")
+    os.makedirs(d)
+    rng = np.random.default_rng(1)
+    # one long chain (40) and one too-short chain (4, below min_len 8)
+    np.savez(os.path.join(d, "long.npz"),
+             seq=rng.integers(0, 20, 40).astype(np.int32),
+             coords=rng.normal(size=(40, 3)).astype(np.float32))
+    np.savez(os.path.join(d, "short.npz"),
+             seq=rng.integers(0, 20, 4).astype(np.int32),
+             coords=rng.normal(size=(4, 3)).astype(np.float32))
+    cfg = DataConfig(crop_len=16, msa_depth=2, msa_len=8, batch_size=1,
+                     min_len_filter=8, source="npz", data_dir=d)
+    it = iter(NpzShardDataset(cfg, seed=0))
+    for _ in range(4):
+        batch = next(it)
+        assert batch["mask"].sum() == 16  # long chain cropped to the window
+        # CA-only shard: backbone synthesized, not left as zeros (the
+        # end2end loss would otherwise train against garbage)
+        assert np.abs(batch["backbone"][0, :48]).sum() > 0
+
+    # nothing passes the filter -> loud error, not an infinite busy loop
+    cfg_bad = DataConfig(crop_len=16, msa_depth=2, msa_len=8, batch_size=1,
+                         min_len_filter=100, source="npz", data_dir=d)
+    with pytest.raises(ValueError, match="length filter"):
+        next(iter(NpzShardDataset(cfg_bad, seed=0)))
