@@ -126,6 +126,65 @@ def ulysses_attention(
                           tiled=True)
 
 
+def _tied_core(q, k, v, num_rows_global: int, axis_name: Optional[str]):
+    """Tied-row contraction; ``axis_name`` completes row-sharded logits with
+    a psum, None means the rows are all local. One source of truth for the
+    scale convention and dtype-cast points."""
+    d = q.shape[-1]
+    scale = d**-0.5 * num_rows_global**-0.5
+    logits = jnp.einsum("brhid,brhjd->bhij", q, k).astype(jnp.float32)
+    if axis_name is not None:
+        logits = lax.psum(logits, axis_name)
+    attn = jax.nn.softmax(logits * scale, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhij,brhjd->brhid", attn, v)
+
+
+def tied_row_attention_sharded(
+    q: jnp.ndarray,  # (B, R_local, H, N, D) — this device's MSA rows
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    num_rows_global: int,
+    axis_name: str = SEQ_AXIS_NAME,
+) -> jnp.ndarray:
+    """Tied-row (MSA-Transformer) attention with rows SHARDED over the mesh.
+
+    The tied attention matrix sums QK^T logits over every MSA row with an
+    extra r^-0.5 scale (SURVEY.md S7: "this is where tied-rows becomes a
+    collective"): each device contracts its local rows, one psum over the
+    row-sharding axis completes the global logits, and the shared softmax
+    is applied to the local rows' values — the MSA need not be replicated.
+    Standalone primitive for row-sharded layouts; the in-model tied path
+    (ops/attention.py tie_dim) currently runs on a replicated MSA.
+    """
+    return _tied_core(q, k, v, num_rows_global, axis_name)
+
+
+def tied_row_attention(
+    q: jnp.ndarray,  # (B, R, H, N, D) global arrays
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Optional[Mesh] = None,
+) -> jnp.ndarray:
+    """Host-level tied-row attention; rows sharded over sp when a mesh is
+    given, dense contraction otherwise. Exact in both modes."""
+    b, r = q.shape[0], q.shape[1]
+    if mesh is None or SEQ_AXIS_NAME not in mesh.axis_names:
+        return _tied_core(q, k, v, r, None)
+    sp = mesh.shape[SEQ_AXIS_NAME]
+    dp = mesh.shape.get(DATA_AXIS_NAME, 1)
+    assert r % sp == 0, f"MSA rows {r} must divide by sp={sp}"
+    assert b % dp == 0, f"batch {b} must divide by dp={dp}"
+    spec = P(DATA_AXIS_NAME, SEQ_AXIS_NAME)
+    mapped = shard_map(
+        partial(tied_row_attention_sharded, num_rows_global=r),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return mapped(q, k, v)
+
+
 def sequence_parallel_attention(
     q: jnp.ndarray,  # (B, H, N, D) — global arrays
     k: jnp.ndarray,

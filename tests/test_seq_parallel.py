@@ -93,6 +93,25 @@ def test_unknown_impl_rejected():
         sequence_parallel_attention(q, k, v, mesh=make_mesh(1, 8), impl="Ring")
 
 
+def test_tied_row_attention_sharded_matches_dense():
+    # MSA rows sharded over sp: psum of per-shard logits must equal the
+    # dense tied contraction exactly (SURVEY.md S7 "tied-rows becomes a
+    # collective")
+    from alphafold2_tpu.parallel.seq_parallel import tied_row_attention
+
+    ks = jax.random.split(jax.random.key(7), 3)
+    q, k, v = (jax.random.normal(kk, (2, 8, 2, 16, 8)) for kk in ks)
+    mesh = make_mesh(2, 4)  # 8 rows / 4-way sharding
+    out = tied_row_attention(q, k, v, mesh=mesh)
+    ref = tied_row_attention(q, k, v, mesh=None)
+    assert np.allclose(out, ref, atol=1e-5), np.abs(np.asarray(out - ref)).max()
+
+    # gradients flow through the psum identically to the dense contraction
+    g = jax.grad(lambda q: jnp.sum(tied_row_attention(q, k, v, mesh=mesh) ** 2))(q)
+    gd = jax.grad(lambda q: jnp.sum(tied_row_attention(q, k, v, mesh=None) ** 2))(q)
+    assert np.allclose(g, gd, atol=1e-4)
+
+
 def test_dense_fallback_without_mesh():
     q, k, v = _qkv(jax.random.key(3))
     out = sequence_parallel_attention(q, k, v, mesh=None)
