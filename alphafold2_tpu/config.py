@@ -26,6 +26,7 @@ class ModelConfig:
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
     remat: bool = False
+    reversible: bool = False  # inversion-based O(1)-memory trunk engine
     sparse_self_attn: bool = False
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False

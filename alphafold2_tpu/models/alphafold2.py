@@ -99,8 +99,10 @@ class TemplateBlock(nn.Module):
 class Alphafold2(nn.Module):
     """Distogram-predicting trunk over a pair grid cross-attending an MSA.
 
-    Ctor parity with reference alphafold2.py:330-350; ``reversible`` is
-    ``remat`` here (same capability, XLA-native mechanism).
+    Ctor parity with reference alphafold2.py:330-350. Two O(1)-activation
+    engines: ``remat`` (XLA rematerialization — recompute in backward) and
+    ``reversible`` (inversion-based coupling, models/reversible.py — the
+    direct equivalent of the reference's reversible trunk).
     """
 
     dim: int
@@ -115,6 +117,7 @@ class Alphafold2(nn.Module):
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
     remat: bool = False
+    reversible: bool = False  # true inversion-based reversible trunk engine
     sparse_self_attn: tuple | bool = False
     sparse_config: Optional[object] = None  # ops.sparse.BlockSparseConfig
     sparse_use_pallas: Optional[bool] = None  # None -> Pallas kernel on TPU
@@ -259,6 +262,7 @@ class Alphafold2(nn.Module):
             context_parallel=self.context_parallel,
             use_flash=self.use_flash,
             remat=self.remat,
+            reversible=self.reversible,
             scan_layers=self.scan_layers,
             dtype=dt,
             name="trunk",

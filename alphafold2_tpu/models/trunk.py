@@ -1,22 +1,27 @@
-"""Trunk execution engine: interleaved [self-attn, cross-attn] layer pairs.
+"""Trunk execution engines: interleaved [self-attn, cross-attn] layer pairs.
 
-Replaces both reference engines with one scan/remat-friendly design:
+Covers both reference engines (alphafold2.py:291-327 SequentialSequence,
+reversible.py ReversibleSequence) with three TPU-native options:
 
-- ``SequentialSequence`` (reference alphafold2.py:291-327): python loop over
-  block pairs with residuals.
-- ``ReversibleSequence`` + hand-written autograd (reference reversible.py):
-  O(1)-in-depth activation memory via inversion with RNG replay. On TPU this
-  collapses into XLA rematerialization — ``remat=True`` wraps each layer in
-  ``jax.checkpoint`` (nn.remat): activations are recomputed in backward,
-  PRNG-key-driven dropout replays bit-exactly by construction (no
-  ``Deterministic`` RNG capture machinery needed, reference reversible.py:26-56).
-  Gradient parity with the non-remat path is proven in
-  tests/test_remat.py — the analogue of reference tests/test_reversible.py.
-
-Unlike the reference, the non-remat and remat configs are parameter-isomorphic
-(the reference drops each self-block's MSA feedforward in the sequential
-engine — alphafold2.py:427-428 — making the two engines different networks;
-SURVEY.md S2.5 flags this as a defect we do not replicate).
+- default: python loop over :class:`TrunkLayer` (the SequentialSequence
+  equivalent); ``scan_layers=True`` rolls it into one ``lax.scan`` with
+  stacked params (depth-independent compile, no reference analogue).
+- ``remat=True``: O(1)-in-depth activation memory via XLA rematerialization
+  (``jax.checkpoint``) — recompute in backward, dropout replayed exactly by
+  stateless PRNG keys (no ``Deterministic`` RNG capture machinery,
+  reference reversible.py:26-56). Parameter-isomorphic with the default
+  engine (the reference's two engines are NOT isomorphic — it drops each
+  self-block's MSA feedforward in the sequential engine, alphafold2.py:
+  427-428; SURVEY.md S2.5 flags this defect and we do not replicate it).
+  Gradient parity proven in tests/test_remat.py.
+- ``reversible=True``: the direct equivalent of the reference's reversible
+  engine — inversion-based O(1) memory coupling (models/reversible.py).
+  A DIFFERENT network from the other two engines (halved two-stream state,
+  twice the feedforwards per depth step, its own stacked parameter tree):
+  checkpoints are not interchangeable across this flag, exactly as
+  reference reversible/sequential configs differ. Takes precedence over
+  ``remat``/``scan_layers`` (it already scans stacked params and needs no
+  remat). Gradient parity of its custom backward: tests/test_reversible.py.
 
 Streams stay in grid form throughout: pair (B, N, N, D), MSA (B, M, Nm, D).
 """
@@ -180,8 +185,10 @@ class _ScanBody(nn.Module):
 
 
 class Trunk(nn.Module):
-    """Stack of TrunkLayers; ``remat=True`` checkpoints each layer (the
-    TPU-native replacement for the reference's reversible engine).
+    """Stack of TrunkLayers; ``remat=True`` checkpoints each layer, and
+    ``reversible=True`` dispatches to the inversion-based engine (see the
+    module docstring for the three-engine map; reversible takes precedence
+    over remat/scan_layers and has its own parameter layout).
 
     ``scan_layers=True`` rolls the depth loop into one ``lax.scan`` over a
     single layer with stacked parameters: the trunk is traced/compiled ONCE
@@ -207,6 +214,7 @@ class Trunk(nn.Module):
     context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
     use_flash: Optional[bool] = None  # fused dense attention on TPU
     remat: bool = False
+    reversible: bool = False  # inversion-based O(1)-memory engine
     scan_layers: bool = False
     dtype: jnp.dtype = jnp.float32
 
@@ -236,6 +244,40 @@ class Trunk(nn.Module):
         if not isinstance(sparse_flags, (tuple, list)):
             sparse_flags = (sparse_flags,) * self.depth
         assert len(sparse_flags) == self.depth
+
+        if self.reversible:
+            # true reversible coupling engine (reference reversible.py);
+            # already scans over stacked per-depth params, so scan_layers
+            # is implied and remat is redundant
+            from alphafold2_tpu.models.reversible import ReversibleTrunk
+
+            assert len(set(sparse_flags)) <= 1, (
+                "the reversible engine scans one stacked layer; per-layer "
+                f"sparse_self_attn={sparse_flags} needs the python loop"
+            )
+            assert self.context_parallel is None, (
+                "context_parallel is not supported by the reversible engine "
+                "(its cross-attention runs dense per device); use "
+                "remat=True with context_parallel, or reversible without it"
+            )
+            return ReversibleTrunk(
+                dim=self.dim,
+                depth=self.depth,
+                heads=self.heads,
+                dim_head=self.dim_head,
+                attn_dropout=self.attn_dropout,
+                ff_dropout=self.ff_dropout,
+                sparse_attn=sparse_flags[0],
+                seq_len=self.seq_len,
+                sparse_config=self.sparse_config,
+                sparse_use_pallas=self.sparse_use_pallas,
+                cross_attn_compress_ratio=self.cross_attn_compress_ratio,
+                msa_tie_row_attn=self.msa_tie_row_attn,
+                use_flash=self.use_flash,
+                dtype=self.dtype,
+                name="reversible",
+            )(x, m, pair_mask=pair_mask, msa_mask=msa_mask,
+              deterministic=deterministic)
 
         if self.scan_layers:
             assert len(set(sparse_flags)) <= 1, (

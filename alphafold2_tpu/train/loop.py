@@ -84,6 +84,7 @@ def build_model(cfg: Config) -> Alphafold2:
         attn_dropout=m.attn_dropout,
         ff_dropout=m.ff_dropout,
         remat=m.remat,
+        reversible=m.reversible,
         sparse_self_attn=m.sparse_self_attn,
         cross_attn_compress_ratio=m.cross_attn_compress_ratio,
         msa_tie_row_attn=m.msa_tie_row_attn,
