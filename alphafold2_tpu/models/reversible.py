@@ -191,10 +191,11 @@ class RevLayerPair(nn.Module):
         return (x1, x2, m1, m2)
 
 
-def _make_rev_scan(forward_one, invert_one):
+def _make_rev_scan(forward_one, backward_one):
     """Build the custom-vjp reversible scan.
 
-    ``forward_one(p, h, pm, mm, key) -> h`` and ``invert_one`` likewise are
+    ``forward_one(p, h, pm, mm, key) -> h`` and
+    ``backward_one(p, h_out, gh, pm, mm, key) -> (h_in, gh_in, gp)`` are
     static closures over the (unbound) layer module and static config only —
     masks and keys are explicit operands, as custom_vjp requires.
     """
@@ -220,12 +221,7 @@ def _make_rev_scan(forward_one, invert_one):
         def body(carry, xs):
             h_out, gh = carry
             p, key = xs
-            h_in = invert_one(p, h_out, pm, mm, key)
-            h_in = jax.tree.map(jax.lax.stop_gradient, h_in)
-            _, pullback = jax.vjp(
-                lambda p_, h_: forward_one(p_, h_, pm, mm, key), p, h_in
-            )
-            gp, gh_in = pullback(gh)
+            h_in, gh_in, gp = backward_one(p, h_out, gh, pm, mm, key)
             return (h_in, gh_in), gp
 
         (h0, gh0), gparams = jax.lax.scan(
@@ -323,18 +319,74 @@ class ReversibleTrunk(nn.Module):
                 rngs={"dropout": jax.random.wrap_key_data(key_data)},
             )
 
-        def invert_one(p, h, pm, mm, key_data):
-            return template.apply(
-                {"params": p}, h,
-                pm if has_pm else None,
-                mm if has_mm else None,
-                det,
-                rngs={"dropout": jax.random.wrap_key_data(key_data)},
-                method=RevLayerPair.invert,
-            )
+        def backward_one(p, h, gh, pm, mm, key_data):
+            """One layer of the reverse schedule: walk the 8 additive updates
+            backwards; each sub-function is evaluated ONCE under jax.vjp and
+            its output reused for both the inversion subtraction and the
+            cotangent pull (the reference's backward_pass schedule,
+            reversible.py:85-156 — one extra evaluation per sub-function,
+            not a full forward re-run)."""
+            pmq = pm if has_pm else None
+            mmq = mm if has_mm else None
+            rngs = {"dropout": jax.random.wrap_key_data(key_data)}
+
+            def vjp(method, *args):
+                def f(p_, *a):
+                    return template.apply(
+                        {"params": p_}, *a, rngs=rngs, method=method
+                    )
+
+                return jax.vjp(f, p, *args)
+
+            x1, x2, m1, m2 = h
+            gx1, gx2, gm1, gm2 = gh
+            add = lambda a, b: jax.tree.map(jnp.add, a, b)
+
+            # 8. m2 += k_c(m1)
+            out, pull = vjp(lambda s, a: s._k_c(a, det), m1)
+            m2 = m2 - out
+            gp, gi = pull(gm2.astype(out.dtype))
+            gm1 = gm1 + gi
+            # 7. m1 += j_c(m2, x2)
+            out, pull = vjp(lambda s, a, b: s._j_c(a, b, pmq, mmq, det), m2, x2)
+            m1 = m1 - out
+            gp_i, gi_m2, gi_x2 = pull(gm1.astype(out.dtype))
+            gp, gm2, gx2 = add(gp, gp_i), gm2 + gi_m2, gx2 + gi_x2
+            # 6. x2 += g_c(x1)
+            out, pull = vjp(lambda s, a: s._g_c(a, det), x1)
+            x2 = x2 - out
+            gp_i, gi = pull(gx2.astype(out.dtype))
+            gp, gx1 = add(gp, gp_i), gx1 + gi
+            # 5. x1 += f_c(x2, m2)
+            out, pull = vjp(lambda s, a, b: s._f_c(a, b, pmq, mmq, det), x2, m2)
+            x1 = x1 - out
+            gp_i, gi_x2, gi_m2 = pull(gx1.astype(out.dtype))
+            gp, gx2, gm2 = add(gp, gp_i), gx2 + gi_x2, gm2 + gi_m2
+            # 4. m2 += k_s(m1)
+            out, pull = vjp(lambda s, a: s._k_s(a, det), m1)
+            m2 = m2 - out
+            gp_i, gi = pull(gm2.astype(out.dtype))
+            gp, gm1 = add(gp, gp_i), gm1 + gi
+            # 3. m1 += j_s(m2)
+            out, pull = vjp(lambda s, a: s._j_s(a, mmq, det), m2)
+            m1 = m1 - out
+            gp_i, gi = pull(gm1.astype(out.dtype))
+            gp, gm2 = add(gp, gp_i), gm2 + gi
+            # 2. x2 += g_s(x1)
+            out, pull = vjp(lambda s, a: s._g_s(a, det), x1)
+            x2 = x2 - out
+            gp_i, gi = pull(gx2.astype(out.dtype))
+            gp, gx1 = add(gp, gp_i), gx1 + gi
+            # 1. x1 += f_s(x2)
+            out, pull = vjp(lambda s, a: s._f_s(a, pmq, det), x2)
+            x1 = x1 - out
+            gp_i, gi = pull(gx1.astype(out.dtype))
+            gp, gx2 = add(gp, gp_i), gx2 + gi
+
+            return (x1, x2, m1, m2), (gx1, gx2, gm1, gm2), gp
 
         if self.use_custom_vjp:
-            h = _make_rev_scan(forward_one, invert_one)(
+            h = _make_rev_scan(forward_one, backward_one)(
                 params, h0, pm_arr, mm_arr, keys
             )
         else:

@@ -1,0 +1,147 @@
+"""2D sequence sharding of the pair grid: rows x cols with all-to-all.
+
+SURVEY.md S7 "hard parts": axial attention needs all of a row (or column)
+local to one device for the attended axis; the clean mesh layout for the
+(B, N, N, D) pair representation is therefore TWO sequence axes — rows
+sharded over ``spr`` and columns over ``spc`` — with an all-to-all transpose
+before/after each axial pass. Per pass, each device temporarily trades a
+factor of the *non-attended* axis for the full *attended* axis:
+
+    at rest:   (B, N/spr, N/spc, ...)           P(dp, spr, spc)
+    row pass:  all_to_all over spc ->  (B, N/(spr*spc), N, ...)   attend cols
+    col pass:  all_to_all over spr ->  (B, N, N/(spr*spc), ...)   attend rows
+
+Peak per-device memory for the pair grid is O(N^2 / (spr*spc)) — square in
+the mesh size rather than linear as with the 1D ``sp`` layout
+(parallel/sharding.py), which is what lets crop 768+ fit a pod slice. The
+collectives are ``lax.all_to_all`` over one mesh axis each, riding ICI.
+
+The reference has no analogue (single device, SURVEY.md S2.3); this and
+ring/Ulysses (parallel/seq_parallel.py) are the green-field long-context
+layer. Everything is jnp-only and differentiable; exactness vs the dense
+oracle (values and grads) is proven on the 8-virtual-device CPU mesh in
+tests/test_grid_parallel.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from alphafold2_tpu.ops.attention import MASK_VALUE
+
+DATA_AXIS_NAME = "dp"
+ROW_AXIS_NAME = "spr"  # shards grid axis 1 (rows / height)
+COL_AXIS_NAME = "spc"  # shards grid axis 2 (cols / width)
+
+
+def make_grid_mesh(
+    n_data: int = 1, n_row: int = 1, n_col: int = 1, devices=None
+) -> Mesh:
+    """A (dp, spr, spc) mesh for 2D pair-grid sharding."""
+    import numpy as np
+
+    devices = devices if devices is not None else jax.devices()
+    n = n_data * n_row * n_col
+    assert n == len(devices), f"mesh {n_data}x{n_row}x{n_col} != {len(devices)}"
+    arr = np.asarray(devices).reshape(n_data, n_row, n_col)
+    return Mesh(arr, (DATA_AXIS_NAME, ROW_AXIS_NAME, COL_AXIS_NAME))
+
+
+def grid_spec() -> P:
+    """At-rest spec for (B, H, W, ...) grid arrays on a grid mesh."""
+    return P(DATA_AXIS_NAME, ROW_AXIS_NAME, COL_AXIS_NAME)
+
+
+def _attend_last_grid_axis(q, k, v, bias):
+    """Dense attention over grid axis 2. q/k/v: (B, R, N, H, D); bias:
+    (B, R, N) additive key bias. Rows R are independent batch entries."""
+    scale = q.shape[-1] ** -0.5
+    dots = jnp.einsum("brihd,brjhd->brhij", q, k).astype(jnp.float32) * scale
+    dots = dots + bias[:, :, None, None, :].astype(jnp.float32)
+    attn = jax.nn.softmax(dots, axis=-1).astype(q.dtype)
+    return jnp.einsum("brhij,brjhd->brihd", attn, v)
+
+
+def _sharded_pass(q, k, v, bias, attend_axis: int):
+    """Runs inside shard_map over (dp, spr, spc). Local blocks:
+    q/k/v (b, hl, wl, heads, d), bias (b, hl, wl)."""
+    if attend_axis == 2:
+        gather_name, split_axis = COL_AXIS_NAME, 1
+    elif attend_axis == 1:
+        gather_name, split_axis = ROW_AXIS_NAME, 2
+    else:
+        raise ValueError(f"attend_axis must be 1 or 2, got {attend_axis}")
+    size = lax.axis_size(gather_name)
+    if q.shape[split_axis] % size:
+        raise ValueError(
+            f"non-attended local axis {q.shape[split_axis]} must divide by "
+            f"mesh axis {gather_name}={size} for the all-to-all transpose"
+        )
+
+    def gather(t):  # trade non-attended axis for the full attended axis
+        return lax.all_to_all(
+            t, gather_name, split_axis=split_axis, concat_axis=attend_axis,
+            tiled=True,
+        )
+
+    def scatter(t):  # inverse transpose
+        return lax.all_to_all(
+            t, gather_name, split_axis=attend_axis, concat_axis=split_axis,
+            tiled=True,
+        )
+
+    q, k, v, bias = gather(q), gather(k), gather(v), gather(bias)
+    if attend_axis == 1:  # put the attended axis last for the shared kernel
+        q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+        bias = jnp.swapaxes(bias, 1, 2)
+    out = _attend_last_grid_axis(q, k, v, bias)
+    if attend_axis == 1:
+        out = jnp.swapaxes(out, 1, 2)
+    return scatter(out)
+
+
+def grid_axial_attention(
+    q: jnp.ndarray,  # (B, H, W, heads, dh) global grid
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,  # (B, H, W) bool key-validity
+    mesh: Optional[Mesh] = None,
+    attend_axis: int = 2,
+) -> jnp.ndarray:
+    """One axial attention pass over a 2D-sharded grid.
+
+    ``attend_axis=2`` attends within rows (over columns), ``attend_axis=1``
+    within columns (over rows) — call twice and sum for the full axial
+    block (ops/attention.py AxialAttention semantics). Exact dense
+    attention in both the sharded and meshless paths.
+    """
+    b, hgrid, wgrid = q.shape[:3]
+    bias = (
+        jnp.where(mask, 0.0, MASK_VALUE).astype(jnp.float32)
+        if mask is not None
+        else jnp.zeros((b, hgrid, wgrid), jnp.float32)
+    )
+    if mesh is None or ROW_AXIS_NAME not in mesh.axis_names:
+        if attend_axis == 1:
+            qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+            out = _attend_last_grid_axis(qt, kt, vt, jnp.swapaxes(bias, 1, 2))
+            return jnp.swapaxes(out, 1, 2)
+        return _attend_last_grid_axis(q, k, v, bias)
+
+    qkv_spec = P(DATA_AXIS_NAME, ROW_AXIS_NAME, COL_AXIS_NAME, None, None)
+    bias_spec = P(DATA_AXIS_NAME, ROW_AXIS_NAME, COL_AXIS_NAME)
+    mapped = shard_map(
+        partial(_sharded_pass, attend_axis=attend_axis),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, bias_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return mapped(q, k, v, bias)
