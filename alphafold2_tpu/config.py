@@ -35,6 +35,8 @@ class ModelConfig:
     context_parallel: Optional[str] = None
     # fused Pallas flash attention for dense paths: None = auto (on TPU)
     flash_attention: Optional[bool] = None
+    # 2D-sharded pair axial attention over a (dp, spr, spc) grid mesh
+    grid_parallel: bool = False
     # compile the trunk as ONE scanned layer with stacked params (compile
     # time independent of depth); needs homogeneous layers
     scan_layers: bool = False
@@ -46,6 +48,10 @@ class ModelConfig:
 class MeshConfig:
     data_parallel: int = 1  # dp axis size; -1 = fill with all devices
     seq_parallel: int = 1  # sp axis size (pair-map row sharding)
+    # 2D pair-grid sharding (parallel/grid_parallel.py); both > 1 builds a
+    # (dp, spr, spc) mesh instead of (dp, sp)
+    grid_rows: int = 1
+    grid_cols: int = 1
 
 
 @dataclass

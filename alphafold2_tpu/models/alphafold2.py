@@ -125,6 +125,7 @@ class Alphafold2(nn.Module):
     msa_tie_row_attn: bool = False
     context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
     use_flash: Optional[bool] = None  # fused dense attention kernel on TPU
+    grid_parallel: bool = False  # 2D-sharded pair axial passes (spr x spc mesh)
     scan_layers: bool = False  # roll the trunk depth loop into lax.scan
     template_attn_depth: int = 2
     use_se3_template_embedder: bool = True
@@ -261,6 +262,7 @@ class Alphafold2(nn.Module):
             msa_tie_row_attn=self.msa_tie_row_attn,
             context_parallel=self.context_parallel,
             use_flash=self.use_flash,
+            grid_parallel=self.grid_parallel,
             remat=self.remat,
             reversible=self.reversible,
             scan_layers=self.scan_layers,

@@ -54,6 +54,7 @@ class TrunkLayer(nn.Module):
     msa_tie_row_attn: bool = False
     context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
     use_flash: Optional[bool] = None  # fused dense attention on TPU
+    grid_parallel: bool = False  # 2D-sharded pair axial passes (spr x spc)
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -79,6 +80,7 @@ class TrunkLayer(nn.Module):
             sparse_config=self.sparse_config,
             sparse_use_pallas=self.sparse_use_pallas,
             use_flash=self.use_flash,
+            grid_parallel=self.grid_parallel,
             dtype=dt,
             name="pair_axial",
         )(ln("pair_axial_norm")(x), mask=pair_mask, deterministic=deterministic)
@@ -213,6 +215,7 @@ class Trunk(nn.Module):
     msa_tie_row_attn: bool = False
     context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
     use_flash: Optional[bool] = None  # fused dense attention on TPU
+    grid_parallel: bool = False  # 2D-sharded pair axial passes (spr x spc)
     remat: bool = False
     reversible: bool = False  # inversion-based O(1)-memory engine
     scan_layers: bool = False
@@ -233,6 +236,7 @@ class Trunk(nn.Module):
             msa_tie_row_attn=self.msa_tie_row_attn,
             context_parallel=self.context_parallel,
             use_flash=self.use_flash,
+            grid_parallel=self.grid_parallel,
             dtype=self.dtype,
         )
 

@@ -76,7 +76,42 @@ class Attention(nn.Module):
     use_flash: Optional[bool] = None  # None -> fused Pallas kernel on TPU
     dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
+    def setup(self):
+        inner = self.heads * self.dim_head
+        self.to_q = nn.Dense(inner, use_bias=False, dtype=self.dtype)
+        self.to_kv = nn.Dense(inner * 2, use_bias=False, dtype=self.dtype)
+        self.to_out = nn.Dense(self.dim, dtype=self.dtype)
+        self.attn_dropout = nn.Dropout(self.dropout)
+        if self.compress_ratio > 1:
+            self.kv_compress = nn.Conv(
+                inner,
+                kernel_size=(self.compress_ratio,),
+                strides=(self.compress_ratio,),
+                feature_group_count=self.heads,
+                padding="VALID",
+                dtype=self.dtype,
+            )
+
+    def grid_axial(self, x, mask=None, attend_axis: int = 2):
+        """Self-attention along ONE axis of a (B, H, W, D) grid with the grid
+        2D-sharded over a (dp, spr, spc) mesh (parallel/grid_parallel.py):
+        projections are pointwise and run on the local shard; the attended
+        axis is gathered by an all-to-all inside the primitive. Exact dense
+        attention; no tied rows / compression / broadcast context here."""
+        from alphafold2_tpu.parallel.grid_parallel import grid_axial_attention
+        from alphafold2_tpu.parallel.sharding import active_mesh
+
+        h, dh = self.heads, self.dim_head
+        b, gh, gw, _ = x.shape
+        q = self.to_q(x).reshape(b, gh, gw, h, dh)
+        k, v = jnp.split(self.to_kv(x), 2, axis=-1)
+        k = k.reshape(b, gh, gw, h, dh)
+        v = v.reshape(b, gh, gw, h, dh)
+        out = grid_axial_attention(
+            q, k, v, mask=mask, mesh=active_mesh(), attend_axis=attend_axis,
+        )
+        return self.to_out(out.reshape(b, gh, gw, h * dh))
+
     def __call__(
         self,
         x,
@@ -91,9 +126,8 @@ class Attention(nn.Module):
         has_context = context is not None
         ctx = context if has_context else x
 
-        q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
-        kv = nn.Dense(inner * 2, use_bias=False, dtype=self.dtype, name="to_kv")(ctx)
-        k, v = jnp.split(kv, 2, axis=-1)
+        q = self.to_q(x)
+        k, v = jnp.split(self.to_kv(ctx), 2, axis=-1)
 
         if self.compress_ratio > 1:
             assert has_context, "KV compression is for cross-attention only"
@@ -103,17 +137,8 @@ class Attention(nn.Module):
             if pad:
                 k = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
                 v = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
-            conv = nn.Conv(
-                inner,
-                kernel_size=(ratio,),
-                strides=(ratio,),
-                feature_group_count=h,
-                padding="VALID",
-                dtype=self.dtype,
-                name="kv_compress",
-            )
-            k = conv(k)
-            v = conv(v)
+            k = self.kv_compress(k)
+            v = self.kv_compress(v)
             if context_mask is not None:
                 cm = context_mask
                 if pad:
@@ -149,7 +174,7 @@ class Attention(nn.Module):
 
         def project_out(out):  # (B, H, n, dh) -> (B, n, dim)
             out = jnp.moveaxis(out, 1, -2).reshape(*x.shape[:-1], inner)
-            return nn.Dense(self.dim, dtype=self.dtype, name="to_out")(out)
+            return self.to_out(out)
 
         # context-parallel path: exact attention with the sequence axis
         # sharded over the mesh's sp axis (ring ppermute or Ulysses
@@ -221,7 +246,7 @@ class Attention(nn.Module):
             dots = jnp.where(pair, dots, MASK_VALUE)
 
         attn = jax.nn.softmax(dots.astype(jnp.float32), axis=-1).astype(self.dtype)
-        attn = nn.Dropout(self.dropout)(attn, deterministic=deterministic)
+        attn = self.attn_dropout(attn, deterministic=deterministic)
 
         if tie_dim is not None:
             out = jnp.einsum("bhij,brjhd->brihd", attn, v)
@@ -230,7 +255,7 @@ class Attention(nn.Module):
             out = jnp.einsum("bhij,bjhd->bihd", attn, v)
 
         out = out.reshape(*out.shape[:-2], inner)
-        return nn.Dense(self.dim, dtype=self.dtype, name="to_out")(out)
+        return self.to_out(out)
 
 
 class AxialAttention(nn.Module):
@@ -255,6 +280,7 @@ class AxialAttention(nn.Module):
     sparse_config: Optional[object] = None  # ops.sparse.BlockSparseConfig
     sparse_use_pallas: Optional[bool] = None  # None -> auto (Pallas on TPU)
     use_flash: Optional[bool] = None  # dense path: fused kernel on TPU
+    grid_parallel: bool = False  # 2D-sharded passes over a (dp, spr, spc) mesh
     dtype: jnp.dtype = jnp.float32
 
     def _attn_cls(self, name):
@@ -294,6 +320,25 @@ class AxialAttention(nn.Module):
         b, height, w, d = x.shape
         attn_width = self._attn_cls("attn_width")
         attn_height = self._attn_cls("attn_height")
+
+        # the grid primitive has no attention-weight dropout; with active
+        # dropout fall through to the regular path rather than silently
+        # dropping the regularization
+        if self.grid_parallel and (self.dropout == 0.0 or deterministic):
+            from alphafold2_tpu.parallel.grid_parallel import ROW_AXIS_NAME
+            from alphafold2_tpu.parallel.sharding import active_mesh
+
+            mesh = active_mesh()
+            if mesh is not None and ROW_AXIS_NAME in mesh.axis_names:
+                assert context is None and not self.tie_row_attn and (
+                    not self.sparse_attn
+                ), "grid_parallel axial attention is the plain self-attn path"
+                # same two passes, each over the 2D-sharded grid:
+                # attn_width attends within columns (over rows, axis 1),
+                # attn_height within rows (over columns, axis 2)
+                w_out = attn_width.grid_axial(x, mask=mask, attend_axis=1)
+                h_out = attn_height.grid_axial(x, mask=mask, attend_axis=2)
+                return w_out + h_out
 
         def broadcast_ctx(n_batch):
             if context is None:

@@ -44,13 +44,25 @@ COL_AXIS_NAME = "spc"  # shards grid axis 2 (cols / width)
 def make_grid_mesh(
     n_data: int = 1, n_row: int = 1, n_col: int = 1, devices=None
 ) -> Mesh:
-    """A (dp, spr, spc) mesh for 2D pair-grid sharding."""
+    """A (dp, spr, spc) mesh for 2D pair-grid sharding.
+
+    Device order comes from ``mesh_utils.create_device_mesh`` so the spr/spc
+    axes land on physically-adjacent chips (their per-layer all_to_all
+    transposes then ride ICI, with dp crossing DCN — same placement policy
+    as distributed.pod_mesh); falls back to raw order off-TPU."""
     import numpy as np
 
     devices = devices if devices is not None else jax.devices()
     n = n_data * n_row * n_col
     assert n == len(devices), f"mesh {n_data}x{n_row}x{n_col} != {len(devices)}"
-    arr = np.asarray(devices).reshape(n_data, n_row, n_col)
+    try:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh(
+            (n_data, n_row, n_col), devices=devices
+        )
+    except Exception:  # non-TPU backends: any order works, nothing to optimize
+        arr = np.asarray(devices).reshape(n_data, n_row, n_col)
     return Mesh(arr, (DATA_AXIS_NAME, ROW_AXIS_NAME, COL_AXIS_NAME))
 
 

@@ -75,6 +75,16 @@ def _constrain(x, spec: P):
 
 
 def pair_spec() -> P:
+    mesh = _active["mesh"]
+    if mesh is not None:
+        from alphafold2_tpu.parallel.grid_parallel import (
+            COL_AXIS_NAME,
+            ROW_AXIS_NAME,
+        )
+
+        if ROW_AXIS_NAME in mesh.axis_names:
+            # 2D grid mesh (parallel/grid_parallel.py): rows x cols sharding
+            return P(DATA_AXIS, ROW_AXIS_NAME, COL_AXIS_NAME)
     return P(DATA_AXIS, SEQ_AXIS)
 
 

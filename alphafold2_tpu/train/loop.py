@@ -90,6 +90,7 @@ def build_model(cfg: Config) -> Alphafold2:
         msa_tie_row_attn=m.msa_tie_row_attn,
         context_parallel=m.context_parallel,
         use_flash=m.flash_attention,
+        grid_parallel=m.grid_parallel,
         scan_layers=m.scan_layers,
         template_attn_depth=m.template_attn_depth,
         dtype=jnp.bfloat16 if m.bfloat16 else jnp.float32,
@@ -240,8 +241,22 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
     data_iter = apply_features(iter(dataset), cfg)
 
     mesh = None
+    if cfg.mesh.grid_rows * cfg.mesh.grid_cols > 1:
+        # 2D pair-grid sharding: (dp, spr, spc) mesh
+        from alphafold2_tpu.parallel.grid_parallel import make_grid_mesh
+
+        if cfg.mesh.seq_parallel > 1 or cfg.model.context_parallel:
+            raise ValueError(
+                "grid_rows/grid_cols builds a (dp, spr, spc) mesh with no "
+                "sp axis: mesh.seq_parallel and model.context_parallel "
+                "cannot be combined with it"
+            )
+        n_dp = cfg.mesh.data_parallel
+        if n_dp == -1:  # fill with all devices, like the 1D path
+            n_dp = jax.device_count() // (cfg.mesh.grid_rows * cfg.mesh.grid_cols)
+        mesh = make_grid_mesh(n_dp, cfg.mesh.grid_rows, cfg.mesh.grid_cols)
     n_mesh = cfg.mesh.data_parallel * cfg.mesh.seq_parallel
-    if n_mesh > 1 or cfg.mesh.seq_parallel > 1:
+    if mesh is None and (n_mesh > 1 or cfg.mesh.seq_parallel > 1):
         # ICI/DCN-aware device ordering over the whole (multi-host) pod
         from alphafold2_tpu.parallel.distributed import pod_mesh
 

@@ -83,6 +83,42 @@ def test_spr_only_grid():
     np.testing.assert_allclose(np.asarray(sharded), np.asarray(dense), atol=2e-5)
 
 
+def test_grid_train_step_matches_single_device():
+    """Full training step with model.grid_parallel=True over a (2, 2, 2)
+    grid mesh == the single-device step (same params, same loss)."""
+    from alphafold2_tpu.config import Config, DataConfig, MeshConfig, ModelConfig, TrainConfig
+    from alphafold2_tpu.data.pipeline import SyntheticDataset
+    from alphafold2_tpu.train.loop import (
+        build_model, device_put_batch, init_state, make_train_step,
+    )
+
+    cfg = Config(
+        model=ModelConfig(dim=32, depth=1, heads=2, dim_head=16,
+                          max_seq_len=64, bfloat16=False, grid_parallel=True),
+        mesh=MeshConfig(data_parallel=2, grid_rows=2, grid_cols=2),
+        data=DataConfig(crop_len=16, msa_depth=2, msa_len=16, batch_size=2,
+                        min_len_filter=8),
+        train=TrainConfig(gradient_accumulate_every=1, warmup_steps=2),
+    )
+    batch = next(iter(SyntheticDataset(cfg.data, seed=4)))
+    model = build_model(cfg)
+
+    state1 = init_state(cfg, model, batch)
+    step1 = make_train_step(model, mesh=None)
+    s1, m1 = step1(state1, device_put_batch(batch), jax.random.key(9))
+
+    mesh = make_grid_mesh(2, 2, 2)
+    state2 = init_state(cfg, model, batch)
+    step2 = make_train_step(model, mesh=mesh)
+    s2, m2 = step2(state2, device_put_batch(batch, mesh), jax.random.key(9))
+
+    assert np.isclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-4), (
+        float(m1["loss"]), float(m2["loss"]),
+    )
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
 def test_indivisible_axis_raises():
     # N/spr = 4 rows per device, spc = 2 -> fine; but N=6 local rows 3 is
     # not divisible by spc=2 for the transpose
