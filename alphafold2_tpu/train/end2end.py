@@ -66,6 +66,7 @@ class End2EndModel(nn.Module):
     mds_iters: int = 200
     refiner_depth: int = 2
     remat: bool = False
+    reversible: bool = False  # inversion-based trunk engine (needs MSA)
     msa_tie_row_attn: bool = False
     context_parallel: Optional[str] = None
     dtype: jnp.dtype = jnp.float32
@@ -82,7 +83,8 @@ class End2EndModel(nn.Module):
         logits = Alphafold2(
             dim=self.dim, depth=self.depth, heads=self.heads,
             dim_head=self.dim_head, max_seq_len=self.max_seq_len,
-            remat=self.remat, msa_tie_row_attn=self.msa_tie_row_attn,
+            remat=self.remat, reversible=self.reversible,
+            msa_tie_row_attn=self.msa_tie_row_attn,
             context_parallel=self.context_parallel,
             dtype=self.dtype, name="af2",
         )(seq3, msa, mask=mask3, msa_mask=msa_mask, embedds=embedds,
@@ -243,7 +245,8 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
     model = End2EndModel(
         dim=cfg.model.dim, depth=cfg.model.depth, heads=cfg.model.heads,
         dim_head=cfg.model.dim_head, max_seq_len=cfg.model.max_seq_len,
-        remat=cfg.model.remat, msa_tie_row_attn=cfg.model.msa_tie_row_attn,
+        remat=cfg.model.remat, reversible=cfg.model.reversible,
+        msa_tie_row_attn=cfg.model.msa_tie_row_attn,
         context_parallel=cfg.model.context_parallel,
         dtype=jnp.bfloat16 if cfg.model.bfloat16 else jnp.float32,
     )

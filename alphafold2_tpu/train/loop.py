@@ -138,12 +138,16 @@ def init_state(cfg: Config, model: Alphafold2, sample_batch: dict) -> TrainState
     )
 
 
-def make_train_step(model: Alphafold2, mesh: Optional[Mesh] = None):
+def make_train_step(
+    model: Alphafold2, mesh: Optional[Mesh] = None, jit: bool = True
+):
     """Build the jitted distogram-pretraining step.
 
     Returns step(state, batch, rng) -> (state, metrics). When a mesh is
     given, inputs/outputs carry explicit shardings and the model's internal
-    sharding constraints are active.
+    sharding constraints are active. ``jit=False`` returns the raw traceable
+    step for embedding in a larger program (e.g. the in-graph multi-step
+    scan in bench.py).
     """
 
     def step(state: TrainState, batch: dict, rng: jax.Array):
@@ -199,6 +203,8 @@ def make_train_step(model: Alphafold2, mesh: Optional[Mesh] = None):
             }
             return new_state, metrics
 
+    if not jit:
+        return step
     if mesh is None:
         return jax.jit(step, donate_argnums=0)
 
@@ -250,6 +256,12 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
                 "grid_rows/grid_cols builds a (dp, spr, spc) mesh with no "
                 "sp axis: mesh.seq_parallel and model.context_parallel "
                 "cannot be combined with it"
+            )
+        if not cfg.model.grid_parallel:
+            raise ValueError(
+                "mesh.grid_rows/grid_cols requires model.grid_parallel=true "
+                "— without it the axial passes run dense and GSPMD "
+                "all-gathers the attended axis, losing the memory benefit"
             )
         n_dp = cfg.mesh.data_parallel
         if n_dp == -1:  # fill with all devices, like the 1D path
