@@ -40,6 +40,7 @@ def _kernel(
     v_ref,  # (1, block, d)
     kmask_ref,  # (1, block) f32 additive key-padding bias (0 or NEG_INF)
     o_ref,  # (1, block, d)
+    lse_ref,  # (1, block) f32 logsumexp out (for the backward kernels)
     m_scr,  # (block, 1) f32 running max
     l_scr,  # (block, 1) f32 running sum
     acc_scr,  # (block, d) f32 accumulator
@@ -84,6 +85,112 @@ def _kernel(
     def _finalize():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        # logsumexp per q row, consumed by the backward kernels
+        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+
+
+def _dq_kernel(
+    idx_ref,  # scalar prefetch: (nb, A) active kv-block ids per q block
+    valid_ref,  # scalar prefetch: (nb, A)
+    q_ref,  # (1, block, d)
+    g_ref,  # (1, block, d) upstream cotangent dO for this q block
+    lse_ref,  # (1, block) f32 logsumexp per q row
+    dsum_ref,  # (1, block) f32 D = rowsum(dO * O)
+    k_ref,  # (1, block, d) a-th active kv block
+    v_ref,  # (1, block, d)
+    kmask_ref,  # (1, block) f32 additive key bias
+    dq_ref,  # (1, block, d) out
+    dq_scr,  # (block, d) f32 accumulator
+    *,
+    scale: float,
+):
+    a = pl.program_id(2)
+    num_a = pl.num_programs(2)
+    qi = pl.program_id(1)
+
+    @pl.when(a == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q, g, k, v = q_ref[0], g_ref[0], k_ref[0], v_ref[0]
+    valid_bias = jnp.where(valid_ref[qi, a] > 0, 0.0, NEG_INF)
+    dots = (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        * scale
+        + kmask_ref[0][None, :]
+        + valid_bias
+    )
+    p = jnp.exp(dots - lse_ref[0][:, None])  # (block, block) normalized probs
+    dp = jax.lax.dot_general(
+        g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - dsum_ref[0][:, None])
+    dq_scr[:] = dq_scr[:] + scale * jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(a == num_a - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    idx_ref,  # scalar prefetch: (nbk, At) active Q-block ids per kv block
+    valid_ref,  # scalar prefetch: (nbk, At)
+    k_ref,  # (1, block, d) this kv block
+    v_ref,  # (1, block, d)
+    kmask_ref,  # (1, block) f32 additive key bias for this kv block
+    q_ref,  # (1, block, d) a-th attending q block
+    g_ref,  # (1, block, d)
+    lse_ref,  # (1, block)
+    dsum_ref,  # (1, block)
+    dk_ref,  # (1, block, d) out
+    dv_ref,  # (1, block, d) out
+    dk_scr,  # (block, d) f32
+    dv_scr,  # (block, d) f32
+    *,
+    scale: float,
+):
+    a = pl.program_id(2)
+    num_a = pl.num_programs(2)
+    kj = pl.program_id(1)
+
+    @pl.when(a == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    k, v, q, g = k_ref[0], v_ref[0], q_ref[0], g_ref[0]
+    valid_bias = jnp.where(valid_ref[kj, a] > 0, 0.0, NEG_INF)
+    dots = (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        * scale
+        + kmask_ref[0][None, :]
+        + valid_bias
+    )
+    p = jnp.exp(dots - lse_ref[0][:, None])  # (block_q, block_k)
+    dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+        p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - dsum_ref[0][:, None])
+    dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(a == num_a - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
@@ -117,9 +224,14 @@ def _run(q, k, v, kmask_bias, idx, valid, block_size, interpret):
                 lambda bh_, qi, a, idx_, val_, h=heads: (bh_ // h, idx_[qi, a]),
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_size, d), lambda bh_, qi, a, idx_, val_: (bh_, qi, 0)
-        ),
+        out_specs=[
+            pl.BlockSpec(
+                (1, block_size, d), lambda bh_, qi, a, idx_, val_: (bh_, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_size), lambda bh_, qi, a, idx_, val_: (bh_, qi)
+            ),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_size, 1), jnp.float32),
             pltpu.VMEM((block_size, 1), jnp.float32),
@@ -130,9 +242,120 @@ def _run(q, k, v, kmask_bias, idx, valid, block_size, interpret):
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, n), jnp.float32),
+        ],
         interpret=interpret,
     )(idx, valid, q, k, v, kmask_bias)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
+def _run_dq(q, g, lse, dsum, k, v, kmask_bias, idx, valid, block_size,
+            interpret):
+    bh, n, d = q.shape
+    nb = n // block_size
+    A = idx.shape[1]
+    b = kmask_bias.shape[0]
+    heads = bh // b
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, nb, A),
+        in_specs=[
+            pl.BlockSpec((1, block_size, d),
+                         lambda bh_, qi, a, idx_, val_: (bh_, qi, 0)),
+            pl.BlockSpec((1, block_size, d),
+                         lambda bh_, qi, a, idx_, val_: (bh_, qi, 0)),
+            pl.BlockSpec((1, block_size),
+                         lambda bh_, qi, a, idx_, val_: (bh_, qi)),
+            pl.BlockSpec((1, block_size),
+                         lambda bh_, qi, a, idx_, val_: (bh_, qi)),
+            pl.BlockSpec((1, block_size, d),
+                         lambda bh_, qi, a, idx_, val_: (bh_, idx_[qi, a], 0)),
+            pl.BlockSpec((1, block_size, d),
+                         lambda bh_, qi, a, idx_, val_: (bh_, idx_[qi, a], 0)),
+            pl.BlockSpec((1, block_size),
+                         lambda bh_, qi, a, idx_, val_, h=heads:
+                         (bh_ // h, idx_[qi, a])),
+        ],
+        out_specs=pl.BlockSpec((1, block_size, d),
+                               lambda bh_, qi, a, idx_, val_: (bh_, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_size, d), jnp.float32)],
+    )
+    kernel = functools.partial(_dq_kernel, scale=d**-0.5)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+        interpret=interpret,
+    )(idx, valid, q, g, lse, dsum, k, v, kmask_bias)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
+def _run_dkv(k, v, kmask_bias, q, g, lse, dsum, idx_t, valid_t, block_size,
+             interpret):
+    bh, n, d = q.shape
+    nbk = n // block_size
+    At = idx_t.shape[1]
+    b = kmask_bias.shape[0]
+    heads = bh // b
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, nbk, At),
+        in_specs=[
+            pl.BlockSpec((1, block_size, d),
+                         lambda bh_, kj, a, idx_, val_: (bh_, kj, 0)),
+            pl.BlockSpec((1, block_size, d),
+                         lambda bh_, kj, a, idx_, val_: (bh_, kj, 0)),
+            pl.BlockSpec((1, block_size),
+                         lambda bh_, kj, a, idx_, val_, h=heads:
+                         (bh_ // h, kj)),
+            pl.BlockSpec((1, block_size, d),
+                         lambda bh_, kj, a, idx_, val_: (bh_, idx_[kj, a], 0)),
+            pl.BlockSpec((1, block_size, d),
+                         lambda bh_, kj, a, idx_, val_: (bh_, idx_[kj, a], 0)),
+            pl.BlockSpec((1, block_size),
+                         lambda bh_, kj, a, idx_, val_: (bh_, idx_[kj, a])),
+            pl.BlockSpec((1, block_size),
+                         lambda bh_, kj, a, idx_, val_: (bh_, idx_[kj, a])),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_size, d),
+                         lambda bh_, kj, a, idx_, val_: (bh_, kj, 0)),
+            pl.BlockSpec((1, block_size, d),
+                         lambda bh_, kj, a, idx_, val_: (bh_, kj, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_size, d), jnp.float32),
+            pltpu.VMEM((block_size, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_dkv_kernel, scale=d**-0.5)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, n, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(idx_t, valid_t, k, v, kmask_bias, q, g, lse, dsum)
+
+
+def _prep(q, mask, layout):
+    from alphafold2_tpu.ops.sparse import active_indices
+
+    b, h, n, d = q.shape
+    idx, valid, _ = active_indices(layout)
+    idx_j = jnp.asarray(idx, dtype=jnp.int32)
+    valid_j = jnp.asarray(valid, dtype=jnp.int32)
+    if mask is None:
+        kmask_bias = jnp.zeros((b, n), dtype=jnp.float32)
+    else:
+        kmask_bias = jnp.where(mask, 0.0, NEG_INF).astype(jnp.float32)
+    return idx_j, valid_j, kmask_bias
 
 
 def pallas_block_sparse_attention(
@@ -143,25 +366,73 @@ def pallas_block_sparse_attention(
     block_size: int,
     mask: Optional[jnp.ndarray] = None,  # (B, N) bool
     interpret: Optional[bool] = None,
-) -> jnp.ndarray:
+    return_lse: bool = False,
+):
     """Flash block-sparse attention over a static layout. Same contract as
-    ops.sparse.block_sparse_attention."""
-    from alphafold2_tpu.ops.sparse import active_indices
-
+    ops.sparse.block_sparse_attention; ``return_lse=True`` additionally
+    returns the per-row logsumexp (B, H, N) for the backward kernels."""
     b, h, n, d = q.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    idx, valid, _ = active_indices(layout)
-    idx_j = jnp.asarray(idx, dtype=jnp.int32)
-    valid_j = jnp.asarray(valid, dtype=jnp.int32)
-
-    if mask is None:
-        kmask_bias = jnp.zeros((b, n), dtype=jnp.float32)
-    else:
-        kmask_bias = jnp.where(mask, 0.0, NEG_INF).astype(jnp.float32)
+    idx_j, valid_j, kmask_bias = _prep(q, mask, layout)
 
     qf = q.reshape(b * h, n, d)
     kf = k.reshape(b * h, n, d)
     vf = v.reshape(b * h, n, d)
-    out = _run(qf, kf, vf, kmask_bias, idx_j, valid_j, block_size, interpret)
-    return out.reshape(b, h, n, d)
+    out, lse = _run(
+        qf, kf, vf, kmask_bias, idx_j, valid_j, block_size, interpret
+    )
+    out = out.reshape(b, h, n, d)
+    if return_lse:
+        return out, lse.reshape(b, h, n)
+    return out
+
+
+def pallas_block_sparse_attention_bwd(
+    q: jnp.ndarray,  # (B, H, N, D)
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    out: jnp.ndarray,  # forward output (for D = rowsum(dO * O))
+    lse: jnp.ndarray,  # (B, H, N) from the forward
+    g: jnp.ndarray,  # upstream cotangent dO
+    layout: np.ndarray,
+    block_size: int,
+    mask: Optional[jnp.ndarray] = None,
+    interpret: Optional[bool] = None,
+):
+    """Fused flash-style backward: dq over the row-wise active lists, dk/dv
+    over the column-wise (transposed-layout) lists. Nothing quadratic is
+    materialized; probabilities are recomputed from q/k and the saved
+    logsumexp (the standard flash-attention backward schedule)."""
+    b, h, n, d = q.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    idx_j, valid_j, kmask_bias = _prep(q, mask, layout)
+    # column-wise active lists: which q blocks attend each kv block
+    from alphafold2_tpu.ops.sparse import active_indices
+
+    idx_t_np, valid_t_np, _ = active_indices(np.asarray(layout).T)
+    idx_t = jnp.asarray(idx_t_np, dtype=jnp.int32)
+    valid_t = jnp.asarray(valid_t_np, dtype=jnp.int32)
+
+    qf = q.reshape(b * h, n, d)
+    kf = k.reshape(b * h, n, d)
+    vf = v.reshape(b * h, n, d)
+    gf = g.reshape(b * h, n, d)
+    of = out.reshape(b * h, n, d)
+    lsef = lse.reshape(b * h, n)
+    dsum = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32), axis=-1)
+
+    dq = _run_dq(
+        qf, gf, lsef, dsum, kf, vf, kmask_bias, idx_j, valid_j, block_size,
+        interpret,
+    )
+    dk, dv = _run_dkv(
+        kf, vf, kmask_bias, qf, gf, lsef, dsum, idx_t, valid_t, block_size,
+        interpret,
+    )
+    return (
+        dq.reshape(b, h, n, d),
+        dk.reshape(b, h, n, d),
+        dv.reshape(b, h, n, d),
+    )

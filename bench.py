@@ -109,17 +109,21 @@ def main():
     vs_baseline = 1.0
     if os.path.exists(baseline_path) and not overridden:
         # the committed baseline is the flagship config on TPU; comparing a
-        # size-overridden smoke run against it would be meaningless
+        # size-overridden smoke run against it would be meaningless — and so
+        # would comparing across timing methodologies (the in-graph step
+        # count changes what per-step time includes), hence the ingraph
+        # match requirement
         with open(baseline_path) as f:
             base = json.load(f)
-        if base.get("value"):
+        if base.get("value") and base.get("ingraph") == INGRAPH:
             vs_baseline = pairs_per_sec / base["value"]
 
     record = {
-        "metric": f"residue-pairs/sec/chip crop={CROP} msa={MSA_DEPTH}x{MSA_LEN} dim={DIM} depth={DEPTH} fwd+bwd+opt",
+        "metric": f"residue-pairs/sec/chip crop={CROP} msa={MSA_DEPTH}x{MSA_LEN} dim={DIM} depth={DEPTH} batch={BATCH} fwd+bwd+opt",
         "value": round(pairs_per_sec, 1),
         "unit": "pairs/sec",
         "vs_baseline": round(vs_baseline, 3),
+        "ingraph": INGRAPH,
     }
     if mfu is not None:
         record["mfu"] = round(mfu, 4)

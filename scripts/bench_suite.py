@@ -106,7 +106,7 @@ def config_1():
     compiled = step.lower(params).compile()
     dt = _timed_loop(lambda: compiled(params)[0])
     return {
-        "config": "1: README functional config fwd+bwd (128 seq, 5x64 MSA)",
+        "config": f"1: README functional config fwd+bwd ({n} seq, {m}x{nm} MSA)",
         "step_ms": round(dt * 1e3, 2),
         "pairs_per_sec": round(n * n / dt, 1),
     }
@@ -207,9 +207,11 @@ def config_5():
         dim_head=cfg.model.dim_head, max_seq_len=cfg.model.max_seq_len,
         reversible=True, mds_iters=8 if SMOKE else 50,
     )
+    from alphafold2_tpu.train.loop import device_put_batch
+
     state = init_end2end_state(cfg, model, batch)
     step = make_end2end_step(model, mesh=None)
-    dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+    dev_batch = device_put_batch(batch)
     rng = jax.random.key(0)
     compiled = step.lower(state, dev_batch, rng).compile()
     box = {"state": state, "rng": rng}

@@ -116,3 +116,34 @@ def test_config_overrides_and_roundtrip():
     assert np.isclose(cfg2.train.learning_rate, 1e-4)
     cfg3 = Config.from_json(cfg2.to_json())
     assert cfg3.model.depth == 12
+
+
+def test_ingraph_multistep_matches_sequential():
+    """bench.py's lax.scan-chained stepping == the same steps dispatched
+    one jit call at a time (same rng schedule, same params)."""
+    cfg = tiny_config()
+    batch = next(iter(SyntheticDataset(cfg.data, seed=3)))
+    model = build_model(cfg)
+    raw_step = make_train_step(model, mesh=None, jit=False)
+    dev_batch = device_put_batch(batch)
+    rng = jax.random.key(11)
+    keys = jax.random.split(rng, 3)
+
+    state_a = init_state(cfg, model, batch)
+    seq_step = jax.jit(raw_step)
+    for r in keys:
+        state_a, _ = seq_step(state_a, dev_batch, r)
+
+    state_b = init_state(cfg, model, batch)
+
+    def multi(state, batch, ks):
+        def body(st, r):
+            st, metrics = raw_step(st, batch, r)
+            return st, metrics["loss"]
+
+        return jax.lax.scan(body, state, ks)
+
+    state_b, losses = jax.jit(multi)(state_b, dev_batch, keys)
+    assert losses.shape == (3,)
+    for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
