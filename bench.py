@@ -107,6 +107,7 @@ def main():
     baseline_path = os.path.join(os.path.dirname(__file__), "bench_baseline.json")
     overridden = any(k.startswith("AF2TPU_BENCH_") for k in os.environ)
     vs_baseline = 1.0
+    compared = False
     if os.path.exists(baseline_path) and not overridden:
         # the committed baseline is the flagship config on TPU; comparing a
         # size-overridden smoke run against it would be meaningless — and so
@@ -117,6 +118,7 @@ def main():
             base = json.load(f)
         if base.get("value") and base.get("ingraph") == INGRAPH:
             vs_baseline = pairs_per_sec / base["value"]
+            compared = True
 
     record = {
         "metric": f"residue-pairs/sec/chip crop={CROP} msa={MSA_DEPTH}x{MSA_LEN} dim={DIM} depth={DEPTH} batch={BATCH} fwd+bwd+opt",
@@ -124,6 +126,10 @@ def main():
         "unit": "pairs/sec",
         "vs_baseline": round(vs_baseline, 3),
         "ingraph": INGRAPH,
+        # False = no comparable baseline (none committed, size override, or
+        # methodology mismatch) — vs_baseline 1.0 then means "not compared",
+        # not "at parity"; re-record bench_baseline.json to re-arm
+        "vs_baseline_valid": compared,
     }
     if mfu is not None:
         record["mfu"] = round(mfu, 4)
