@@ -82,6 +82,40 @@ class PDBStructure:
         seq = "".join(THREE_TO_ONE.get(str(r), "X") for r in sub.resname)
         return seq, sub.coords.copy()
 
+    def backbone_trace(
+        self, return_indices: bool = False
+    ) -> tuple[str, np.ndarray] | tuple[str, np.ndarray, np.ndarray]:
+        """(sequence, (L, 3, 3) N/CA/C coords) over protein residues that
+        have all three backbone atoms, file order. ``return_indices`` adds
+        the (L, 3) row indices of those atoms into THIS structure's arrays
+        (for scattering modified coordinates back without losing chains,
+        numbering, or other atoms)."""
+        residues: dict = {}
+        order: list = []
+        for i in range(len(self)):
+            if self.hetero[i]:
+                continue
+            key = (str(self.chain[i]), int(self.resseq[i]))
+            if key not in residues:
+                residues[key] = {"resname": str(self.resname[i])}
+                order.append(key)
+            nm = str(self.name[i])
+            if nm in ("N", "CA", "C") and nm not in residues[key]:
+                residues[key][nm] = i
+        seq_chars, coords, indices = [], [], []
+        for key in order:
+            r = residues[key]
+            if all(nm in r for nm in ("N", "CA", "C")):
+                seq_chars.append(THREE_TO_ONE.get(r["resname"], "X"))
+                rows = [r["N"], r["CA"], r["C"]]
+                indices.append(rows)
+                coords.append([self.coords[j] for j in rows])
+        seq = "".join(seq_chars)
+        coords_arr = np.asarray(coords, np.float32).reshape(-1, 3, 3)
+        if return_indices:
+            return seq, coords_arr, np.asarray(indices, np.int64).reshape(-1, 3)
+        return seq, coords_arr
+
 
 def parse_pdb(text: str) -> PDBStructure:
     """Parse ATOM/HETATM records (first MODEL only) from PDB-format text."""

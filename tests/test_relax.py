@@ -1,0 +1,124 @@
+"""Native relaxation tests: energy decreases, ideal bond geometry is
+approached, masking freezes padded atoms, and the refinement CLI's native
+path round-trips a PDB. (The reference's FastRelax was a NotImplementedError
+stub — this capability is beyond-reference; the stub contract itself is
+covered by driving scripts/refinement.py without pyrosetta.)"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.utils.relax import backbone_energy, fast_relax
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _noisy_backbone(key, L=8, noise=0.3):
+    """A roughly-extended chain with ~ideal spacing, perturbed."""
+    ideal = jnp.array([1.458, 1.525, 1.329])
+    steps = jnp.tile(ideal, L)[: L * 3 - 1]
+    x = jnp.concatenate([jnp.zeros((1,)), jnp.cumsum(steps)])
+    base = jnp.stack([x, jnp.zeros_like(x), jnp.zeros_like(x)], -1)
+    return base[None] + noise * jax.random.normal(key, (1, L * 3, 3))
+
+
+def test_relax_decreases_energy_and_fixes_bonds():
+    bb = _noisy_backbone(jax.random.key(0))
+    res = jax.jit(lambda c: fast_relax(c, iters=150))(bb)
+    e0 = float(res.energy_history[0, 0])
+    e1 = float(res.energy[0])
+    assert e1 < e0 * 0.5, (e0, e1)
+
+    def bond_rmse(c):
+        d = jnp.linalg.norm(c[0, 1:] - c[0, :-1], axis=-1)
+        ideal = jnp.tile(jnp.array([1.458, 1.525, 1.329]), d.shape[0] // 3 + 1)[
+            : d.shape[0]
+        ]
+        return float(jnp.sqrt(jnp.mean((d - ideal) ** 2)))
+
+    assert bond_rmse(res.coords) < bond_rmse(bb) * 0.6
+
+
+def test_relax_respects_mask():
+    bb = _noisy_backbone(jax.random.key(1), L=6)
+    mask = jnp.ones((1, 18), bool).at[:, 9:].set(False)
+    res = fast_relax(bb, mask=mask, iters=20)
+    np.testing.assert_allclose(
+        np.asarray(res.coords[0, 9:]), np.asarray(bb[0, 9:]), atol=1e-6
+    )
+    assert not np.allclose(np.asarray(res.coords[0, :9]), np.asarray(bb[0, :9]))
+
+
+def test_relax_is_differentiable():
+    bb = _noisy_backbone(jax.random.key(2), L=4)
+
+    def loss(c):
+        return jnp.sum(fast_relax(c, iters=5).coords ** 2)
+
+    g = jax.grad(loss)(bb)
+    assert np.isfinite(np.asarray(g)).all() and float(jnp.abs(g).sum()) > 0
+
+
+def test_energy_clash_term_penalizes_overlap():
+    # two far-apart fragments vs collapsed-to-a-point coordinates
+    spread = _noisy_backbone(jax.random.key(3), L=4, noise=0.0)
+    collapsed = jnp.zeros_like(spread)
+    e_spread = float(backbone_energy(spread, spread)[0])
+    e_collapsed = float(backbone_energy(collapsed, collapsed)[0])
+    assert e_collapsed > e_spread
+
+
+def test_refinement_cli_native_roundtrip(tmp_path):
+    from alphafold2_tpu.utils.pdb import backbone_to_pdb, to_pdb_string
+
+    bb = np.asarray(_noisy_backbone(jax.random.key(4), L=5)[0]).reshape(5, 3, 3)
+    pdb_in = tmp_path / "in.pdb"
+    pdb_out = tmp_path / "out.pdb"
+    pdb_in.write_text(to_pdb_string(backbone_to_pdb("AGAGA", bb)))
+    env = dict(os.environ, AF2TPU_PLATFORM="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, "scripts/refinement.py", str(pdb_in), str(pdb_out),
+         "--native", "--iters", "30"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert "energy" in proc.stdout
+    from alphafold2_tpu.utils.pdb import load_pdb
+
+    seq, out_bb = load_pdb(str(pdb_out)).backbone_trace()
+    assert seq == "AGAGA" and out_bb.shape == (5, 3, 3)
+
+
+def test_bond_term_skips_chain_breaks():
+    """A gap in the reference geometry (chain break) must not be pulled to
+    bond length: the bond restraint is derived from the input's own
+    geometry, not blind i/i+1 adjacency."""
+    a = _noisy_backbone(jax.random.key(5), L=3, noise=0.0)
+    b = _noisy_backbone(jax.random.key(6), L=3, noise=0.0) + jnp.array(
+        [40.0, 0.0, 0.0]
+    )
+    two_chains = jnp.concatenate([a, b], axis=1)  # C...N gap of ~27 A
+    res = fast_relax(two_chains, iters=100)
+    gap = float(jnp.linalg.norm(res.coords[0, 9] - res.coords[0, 8]))
+    assert gap > 20.0, f"chain break collapsed to {gap:.2f} A"
+
+
+def test_refinement_cli_stub_contract(tmp_path):
+    """Without pyrosetta and without --native, the reference's contract
+    holds: config loads, then NotImplementedError."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    import importlib
+
+    import refinement
+
+    importlib.reload(refinement)
+    if refinement.HAS_PYROSETTA:
+        pytest.skip("pyrosetta installed")
+    with pytest.raises(NotImplementedError):
+        refinement.run_fast_relax("x.pdb", "y.pdb")
