@@ -227,7 +227,7 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
     import time
 
     from alphafold2_tpu.data.pipeline import make_dataset
-    from alphafold2_tpu.train.loop import apply_features, device_put_batch
+    from alphafold2_tpu.train.loop import apply_features
     from alphafold2_tpu.train.observe import MetricsLogger
 
     num_steps = num_steps or cfg.train.num_steps
@@ -267,7 +267,12 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
     logger = MetricsLogger(cfg.train.checkpoint_dir)
     rng = jax.random.key(cfg.train.seed + 1)
 
-    batch = device_put_batch(sample, mesh)
+    from itertools import chain
+
+    from alphafold2_tpu.train.loop import device_prefetch
+
+    prefetched = device_prefetch(chain([sample], data_iter), mesh)
+    batch = next(prefetched)
     t0 = time.perf_counter()
     for i in range(start_step, num_steps):
         rng, r = jax.random.split(rng)
@@ -281,7 +286,7 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
             logger.log(i, m)
         if ckpt is not None and (i + 1) % cfg.train.checkpoint_every == 0:
             ckpt.save(i + 1, state)
-        batch = device_put_batch(next(data_iter), mesh)
+        batch = next(prefetched)
     if ckpt is not None:
         ckpt.save(num_steps, state)
         ckpt.wait()

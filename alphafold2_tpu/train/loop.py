@@ -218,6 +218,33 @@ def make_train_step(
     )
 
 
+def device_prefetch(data_iter, mesh: Optional[Mesh] = None, size: int = 2):
+    """Wrap a host batch iterator with an N-deep on-device prefetch queue.
+
+    ``jax.device_put`` is async: enqueueing the NEXT batch's transfer before
+    the current step is consumed overlaps host->device copy with device
+    compute (the reference's single-device loop has no such overlap; its
+    DataLoader prefetches only into host memory). Python-level, so it works
+    for any of the data sources including the native C++ loader."""
+    from collections import deque
+
+    queue: deque = deque()
+    it = iter(data_iter)
+    try:
+        for _ in range(size):
+            queue.append(device_put_batch(next(it), mesh))
+        while queue:
+            out = queue.popleft()
+            try:
+                queue.append(device_put_batch(next(it), mesh))
+            except StopIteration:
+                pass
+            yield out
+    except StopIteration:
+        while queue:
+            yield queue.popleft()
+
+
 def device_put_batch(batch: dict, mesh: Optional[Mesh] = None) -> dict:
     if mesh is None:
         return {k: jnp.asarray(v) for k, v in batch.items()}
@@ -324,7 +351,12 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
             )
         return stop["requested"]
 
-    batch = device_put_batch(sample, mesh)
+    # on-device prefetch: the next batch's host->device transfer overlaps
+    # the current step's compute
+    from itertools import chain
+
+    prefetched = device_prefetch(chain([sample], data_iter), mesh)
+    batch = next(prefetched)
     t0 = time.perf_counter()
     for i in range(start_step, num_steps):
         profiler.maybe_start(i)
@@ -348,7 +380,7 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
             if ckpt.latest_step() != i + 1:
                 ckpt.save(i + 1, state)
             break
-        batch = device_put_batch(next(data_iter), mesh)
+        batch = next(prefetched)
     if prev_handler is not None:
         signal.signal(signal.SIGTERM, prev_handler)
     if ckpt is not None:

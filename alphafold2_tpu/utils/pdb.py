@@ -58,15 +58,23 @@ class PDBStructure:
     coords: np.ndarray  # (N, 3) float32 Angstroms
     element: np.ndarray  # (N,) <U2
     hetero: np.ndarray  # (N,) bool — HETATM record
+    icode: np.ndarray = None  # (N,) <U1 insertion code ('' when absent)
+
+    def __post_init__(self):
+        if self.icode is None:  # constructors predating insertion codes
+            self.icode = np.full(len(self.serial), "", "<U1")
 
     def __len__(self) -> int:
         return len(self.serial)
+
+    def _icode(self) -> np.ndarray:
+        return self.icode
 
     def select(self, mask: np.ndarray) -> "PDBStructure":
         return PDBStructure(
             self.serial[mask], self.name[mask], self.resname[mask],
             self.chain[mask], self.resseq[mask], self.coords[mask],
-            self.element[mask], self.hetero[mask],
+            self.element[mask], self.hetero[mask], self._icode()[mask],
         )
 
     def chains(self) -> list[str]:
@@ -92,10 +100,11 @@ class PDBStructure:
         numbering, or other atoms)."""
         residues: dict = {}
         order: list = []
+        icodes = self._icode()
         for i in range(len(self)):
             if self.hetero[i]:
                 continue
-            key = (str(self.chain[i]), int(self.resseq[i]))
+            key = (str(self.chain[i]), int(self.resseq[i]), str(icodes[i]))
             if key not in residues:
                 residues[key] = {"resname": str(self.resname[i])}
                 order.append(key)
@@ -120,7 +129,7 @@ class PDBStructure:
 def parse_pdb(text: str) -> PDBStructure:
     """Parse ATOM/HETATM records (first MODEL only) from PDB-format text."""
     serial, name, resname, chain, resseq = [], [], [], [], []
-    coords, element, hetero = [], [], []
+    coords, element, hetero, icode = [], [], [], []
     for line in text.splitlines():
         rec = line[:6]
         if rec == "ENDMDL":  # first model only, like mdtraj's default frame
@@ -135,6 +144,7 @@ def parse_pdb(text: str) -> PDBStructure:
         resname.append(line[17:20].strip())
         chain.append(line[21])
         resseq.append(int(line[22:26]))
+        icode.append(line[26].strip() if len(line) > 26 else "")
         coords.append(
             (float(line[30:38]), float(line[38:46]), float(line[46:54]))
         )
@@ -146,6 +156,7 @@ def parse_pdb(text: str) -> PDBStructure:
         np.asarray(resseq, np.int32),
         np.asarray(coords, np.float32).reshape(-1, 3),
         np.asarray(element, "<U2"), np.asarray(hetero, bool),
+        np.asarray(icode, "<U1"),
     )
 
 
@@ -167,9 +178,10 @@ def to_pdb_string(s: PDBStructure) -> str:
         # PDB atom-name column quirk: 1-letter elements start at col 14
         nm = f" {nm:<3}" if len(nm) < 4 and len(str(s.element[i])) < 2 else f"{nm:<4}"
         x, y, z = (float(v) for v in s.coords[i])
+        ic = str(s._icode()[i]) or " "
         lines.append(
             f"{rec}{int(s.serial[i]):5d} {nm} {str(s.resname[i]):>3}"
-            f" {str(s.chain[i])}{int(s.resseq[i]):4d}    "
+            f" {str(s.chain[i])}{int(s.resseq[i]):4d}{ic}   "
             f"{x:8.3f}{y:8.3f}{z:8.3f}{1.0:6.2f}{0.0:6.2f}"
             f"          {str(s.element[i]):>2}"
         )

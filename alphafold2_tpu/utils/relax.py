@@ -68,15 +68,36 @@ def backbone_energy(
     pair_m = fm[:, 1:] * fm[:, :-1] * is_bond
     e_bond = jnp.sum(pair_m * (lengths - ideal) ** 2, -1)
 
-    # soft-sphere clashes between non-bonded pairs (|i-j| > 2)
-    d2 = jnp.sum(
-        (coords[:, :, None, :] - coords[:, None, :, :]) ** 2, -1
-    )  # (B, L3, L3)
-    d = jnp.sqrt(d2 + 1e-12)
-    idx = jnp.arange(l3)
-    nonbonded = (jnp.abs(idx[:, None] - idx[None, :]) > 2)[None]
-    pm = fm[:, :, None] * fm[:, None, :] * nonbonded
-    e_clash = jnp.sum(pm * jnp.maximum(clash_dist - d, 0.0) ** 2, (-1, -2)) / 2
+    # soft-sphere clashes between non-bonded pairs (|i-j| > 2). Above a few
+    # thousand atoms the dense (B, L3, L3) distance matrix would dominate
+    # memory (and OOM under grad), so large structures stream row-chunks
+    # with lax.map: peak extra memory O(B * chunk * L3).
+    def _clash_rows(rows, frows, iglob, all_coords, fall, jidx):
+        d = jnp.sqrt(
+            jnp.sum((rows[:, :, None, :] - all_coords[:, None, :, :]) ** 2, -1)
+            + 1e-12
+        )
+        nb = (jnp.abs(iglob[:, None] - jidx[None, :]) > 2)[None]
+        pm = frows[:, :, None] * fall[:, None, :] * nb
+        return jnp.sum(pm * jnp.maximum(clash_dist - d, 0.0) ** 2, (-1, -2))
+
+    jidx = jnp.arange(l3)
+    if l3 <= 1536:
+        e_clash = _clash_rows(coords, fm, jidx, coords, fm, jidx) / 2
+    else:
+        chunk = 512
+        pad = (-l3) % chunk
+        cp = jnp.pad(coords, ((0, 0), (0, pad), (0, 0)))
+        fp = jnp.pad(fm, ((0, 0), (0, pad)))
+        jp = jnp.arange(l3 + pad)
+
+        def one(start):
+            rows = jax.lax.dynamic_slice_in_dim(cp, start, chunk, axis=1)
+            frows = jax.lax.dynamic_slice_in_dim(fp, start, chunk, axis=1)
+            return _clash_rows(rows, frows, start + jnp.arange(chunk), cp, fp, jp)
+
+        starts = jnp.arange((l3 + pad) // chunk) * chunk
+        e_clash = jnp.sum(jax.lax.map(one, starts), axis=0) / 2
 
     # restraint to the prediction
     e_rest = jnp.sum(fm * jnp.sum((coords - ref_coords) ** 2, -1), -1)

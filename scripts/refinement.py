@@ -126,6 +126,10 @@ if __name__ == "__main__":
     ap.add_argument("--iters", type=int, default=200)
     args = ap.parse_args()
     if args.native:
+        if args.config is not None:
+            ap.error("--config applies to the pyrosetta path, not --native")
         run_native_relax(args.pdb_in, args.pdb_out, iters=args.iters)
     else:
+        if args.iters != 200:
+            ap.error("--iters applies to --native; use --config for pyrosetta")
         run_fast_relax(args.pdb_in, args.pdb_out, config_path=args.config)

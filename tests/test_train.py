@@ -147,3 +147,15 @@ def test_ingraph_multistep_matches_sequential():
     assert losses.shape == (3,)
     for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_device_prefetch_order_and_exhaustion():
+    from alphafold2_tpu.train.loop import device_prefetch
+
+    batches = [{"seq": np.full((1, 4), i)} for i in range(5)]
+    got = [int(b["seq"][0, 0]) for b in device_prefetch(iter(batches), size=2)]
+    assert got == [0, 1, 2, 3, 4]
+    # shorter than the prefetch depth
+    got = [int(b["seq"][0, 0]) for b in device_prefetch(iter(batches[:1]), size=3)]
+    assert got == [0]
+    assert list(device_prefetch(iter([]), size=2)) == []
