@@ -51,6 +51,13 @@ def flash_attention(
 
     b, h, nq, d = q.shape
     nk = k.shape[2]
+
+    # short sequences (either axis < one 128 block) use the dense path by
+    # design: the stock backward kernels hard-require kv blocks of >= 128
+    # (MIN_BLOCK_SIZE tiling), so sub-block shapes cannot run fused training
+    # — and at these sizes the dense attention matrix is trivially small
+    if nq < 128 or nk < 128:
+        return None
     segment_ids = None
     if q_mask is not None or kv_mask is not None:
         qs = (
