@@ -91,8 +91,10 @@ class SyntheticDataset:
                 "coords": np.zeros((B, L, 3), np.float32),
                 "backbone": np.zeros((B, L * 3, 3), np.float32),
             }
+            min_len = min(cfg.min_len_filter, L)  # crop shorter than the
+            # filter floor: full-length chains, not a crash
             for b in range(B):
-                true_len = int(rng.integers(cfg.min_len_filter, L + 1))
+                true_len = int(rng.integers(min_len, L + 1))
                 seq = rng.integers(0, 20, size=true_len)
                 ca = _smooth_walk(rng, true_len)
                 batch["seq"][b, :true_len] = seq

@@ -172,8 +172,13 @@ def test_tsan_stress_clean():
 
 @needs_lib
 def test_min_len_exceeds_crop_len_is_safe():
-    # numpy twin raises for this config; native clamps instead of corrupting
+    # both sources clamp min_len to the crop: full-length chains, no error
     cfg = _cfg(crop_len=8, min_len_filter=16)
     b = native.synthesize_batch(cfg, seed=0)
     assert b["mask"].all()  # chain fills the whole crop
     assert (b["seq"] < 20).all()
+
+    from alphafold2_tpu.data.pipeline import SyntheticDataset
+
+    nb = next(iter(SyntheticDataset(cfg, seed=0)))
+    assert nb["mask"].all()
