@@ -163,3 +163,36 @@ def test_axial_attention_broadcast_context():
         )
     )(ctx)
     assert np.isfinite(np.asarray(g)).all()
+
+
+def test_templates_explicit_distogram():
+    """User-supplied template distance buckets skip auto-binning (reference
+    alphafold2.py:508-509) and produce the same result as pre-bucketing the
+    coordinates manually."""
+    from alphafold2_tpu.utils.structure import get_bucketed_distance_matrix
+
+    b, n, T = 1, 8, 2
+    model = Alphafold2(dim=32, depth=1, heads=2, dim_head=16, max_seq_len=32,
+                       template_attn_depth=1, use_se3_template_embedder=False,
+                       use_flash=False)
+    k = jax.random.key(41)
+    seq = jax.random.randint(jax.random.fold_in(k, 0), (b, n), 0, 21)
+    msa = jax.random.randint(jax.random.fold_in(k, 1), (b, 2, n), 0, 21)
+    t_seq = jax.random.randint(jax.random.fold_in(k, 2), (b, T, n), 0, 21)
+    t_coors = jax.random.normal(jax.random.fold_in(k, 3), (b, T, n, 3)) * 5
+    masks = dict(
+        mask=jnp.ones((b, n), bool), msa_mask=jnp.ones((b, 2, n), bool),
+        templates_mask=jnp.ones((b, T, n), bool),
+    )
+    params = model.init(k, seq, msa, templates_seq=t_seq,
+                        templates_coors=t_coors, **masks)
+    out_auto = model.apply(params, seq, msa, templates_seq=t_seq,
+                           templates_coors=t_coors, **masks)
+    t_dist = jnp.maximum(
+        get_bucketed_distance_matrix(t_coors, masks["templates_mask"]), 0
+    )
+    out_explicit = model.apply(params, seq, msa, templates_seq=t_seq,
+                               templates_coors=t_coors, templates_dist=t_dist,
+                               **masks)
+    np.testing.assert_allclose(np.asarray(out_auto), np.asarray(out_explicit),
+                               atol=1e-5)
