@@ -30,6 +30,9 @@ class ModelConfig:
     sparse_self_attn: bool = False
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
+    # shard the MSA-row axis over sp: the tied-row logit sum completes via
+    # an XLA-inserted psum, scaling MSA depth across the mesh
+    msa_row_shard: bool = False
     # sequence/context parallelism for the cross-attention over the N^2 pair
     # tokens: None | "ring" | "ulysses" (parallel/seq_parallel.py)
     context_parallel: Optional[str] = None

@@ -123,6 +123,7 @@ class Alphafold2(nn.Module):
     sparse_use_pallas: Optional[bool] = None  # None -> Pallas kernel on TPU
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
+    msa_row_shard: bool = False  # shard MSA rows over sp (tied-row psum)
     context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
     use_flash: Optional[bool] = None  # fused dense attention kernel on TPU
     grid_parallel: bool = False  # 2D-sharded pair axial passes (spr x spc mesh)
@@ -187,7 +188,7 @@ class Alphafold2(nn.Module):
             if mask is not None:
                 m_mask = mask[:, :, None] & mask[:, None, :]
         if m is not None:
-            m = shard_msa(m)
+            m = shard_msa(m, rows=self.msa_row_shard)
 
         # template stream
         if templates_seq is not None:
@@ -260,6 +261,7 @@ class Alphafold2(nn.Module):
             sparse_use_pallas=self.sparse_use_pallas,
             cross_attn_compress_ratio=self.cross_attn_compress_ratio,
             msa_tie_row_attn=self.msa_tie_row_attn,
+            msa_row_shard=self.msa_row_shard,
             context_parallel=self.context_parallel,
             use_flash=self.use_flash,
             grid_parallel=self.grid_parallel,

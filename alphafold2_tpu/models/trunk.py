@@ -52,6 +52,7 @@ class TrunkLayer(nn.Module):
     sparse_use_pallas: Optional[bool] = None
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
+    msa_row_shard: bool = False  # shard MSA rows over sp (tied psum via GSPMD)
     context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
     use_flash: Optional[bool] = None  # fused dense attention on TPU
     grid_parallel: bool = False  # 2D-sharded pair axial passes (spr x spc)
@@ -98,7 +99,7 @@ class TrunkLayer(nn.Module):
                 dtype=dt,
                 name="msa_axial",
             )(ln("msa_axial_norm")(m), mask=msa_mask, deterministic=deterministic)
-            m = shard_msa(m)
+            m = shard_msa(m, rows=self.msa_row_shard)
 
             # cross-attention: pair tokens query the MSA stream and vice versa
             b, n, n2, d = x.shape
@@ -146,7 +147,7 @@ class TrunkLayer(nn.Module):
                 deterministic=deterministic,
             )
             x = shard_pair(x_flat.reshape(b, n, n2, d))
-            m = shard_msa(m_flat.reshape(bm, mm, nm, d))
+            m = shard_msa(m_flat.reshape(bm, mm, nm, d), rows=self.msa_row_shard)
 
         # feedforwards
         x = x + FeedForward(
@@ -157,7 +158,7 @@ class TrunkLayer(nn.Module):
             m = m + FeedForward(
                 dim=self.dim, dropout=self.ff_dropout, dtype=dt, name="msa_ff"
             )(ln("msa_ff_norm")(m), deterministic=deterministic)
-            m = shard_msa(m)
+            m = shard_msa(m, rows=self.msa_row_shard)
 
         return x, m
 
@@ -213,6 +214,7 @@ class Trunk(nn.Module):
     sparse_use_pallas: Optional[bool] = None
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
+    msa_row_shard: bool = False  # shard MSA rows over sp (tied psum via GSPMD)
     context_parallel: Optional[str] = None  # None | "ring" | "ulysses"
     use_flash: Optional[bool] = None  # fused dense attention on TPU
     grid_parallel: bool = False  # 2D-sharded pair axial passes (spr x spc)
@@ -234,6 +236,7 @@ class Trunk(nn.Module):
             sparse_use_pallas=self.sparse_use_pallas,
             cross_attn_compress_ratio=self.cross_attn_compress_ratio,
             msa_tie_row_attn=self.msa_tie_row_attn,
+            msa_row_shard=self.msa_row_shard,
             context_parallel=self.context_parallel,
             use_flash=self.use_flash,
             grid_parallel=self.grid_parallel,
@@ -263,6 +266,11 @@ class Trunk(nn.Module):
                 "context_parallel is not supported by the reversible engine "
                 "(its cross-attention runs dense per device); use "
                 "remat=True with context_parallel, or reversible without it"
+            )
+            assert not self.msa_row_shard, (
+                "msa_row_shard is not supported by the reversible engine "
+                "(its MSA streams are replicated); use remat=True to "
+                "combine MSA-row sharding with O(1) activation memory"
             )
             return ReversibleTrunk(
                 dim=self.dim,

@@ -88,7 +88,15 @@ def pair_spec() -> P:
     return P(DATA_AXIS, SEQ_AXIS)
 
 
-def msa_spec() -> P:
+def msa_spec(rows: bool = False) -> P:
+    """MSA grid (B, M, Nm, D) layout: replicated over sp by default (M is
+    tiny next to N^2); ``rows=True`` shards the row axis over sp — the
+    tied-row logit contraction then completes with an XLA-inserted psum
+    (SURVEY.md S7: "tied-rows becomes a collective"), scaling MSA depth."""
+    if rows:
+        mesh = _active["mesh"]
+        if mesh is not None and SEQ_AXIS in mesh.axis_names:
+            return P(DATA_AXIS, SEQ_AXIS)
     return P(DATA_AXIS)
 
 
@@ -101,9 +109,10 @@ def shard_pair(x):
     return _constrain(x, pair_spec())
 
 
-def shard_msa(m):
-    """Constrain a (B, M, Nm, D) MSA array: batch sharded, replicated on sp."""
-    return _constrain(m, msa_spec())
+def shard_msa(m, rows: bool = False):
+    """Constrain a (B, M, Nm, D) MSA array: batch sharded; ``rows=True``
+    additionally shards the MSA-row axis over sp (see :func:`msa_spec`)."""
+    return _constrain(m, msa_spec(rows))
 
 
 def shard_batch(t):

@@ -68,6 +68,7 @@ class End2EndModel(nn.Module):
     remat: bool = False
     reversible: bool = False  # inversion-based trunk engine (needs MSA)
     msa_tie_row_attn: bool = False
+    msa_row_shard: bool = False  # shard MSA rows over sp (tied-row psum)
     context_parallel: Optional[str] = None
     dtype: jnp.dtype = jnp.float32
 
@@ -85,6 +86,7 @@ class End2EndModel(nn.Module):
             dim_head=self.dim_head, max_seq_len=self.max_seq_len,
             remat=self.remat, reversible=self.reversible,
             msa_tie_row_attn=self.msa_tie_row_attn,
+            msa_row_shard=self.msa_row_shard,
             context_parallel=self.context_parallel,
             dtype=self.dtype, name="af2",
         )(seq3, msa, mask=mask3, msa_mask=msa_mask, embedds=embedds,
@@ -247,6 +249,7 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
         dim_head=cfg.model.dim_head, max_seq_len=cfg.model.max_seq_len,
         remat=cfg.model.remat, reversible=cfg.model.reversible,
         msa_tie_row_attn=cfg.model.msa_tie_row_attn,
+        msa_row_shard=cfg.model.msa_row_shard,
         context_parallel=cfg.model.context_parallel,
         dtype=jnp.bfloat16 if cfg.model.bfloat16 else jnp.float32,
     )

@@ -88,6 +88,7 @@ def build_model(cfg: Config) -> Alphafold2:
         sparse_self_attn=m.sparse_self_attn,
         cross_attn_compress_ratio=m.cross_attn_compress_ratio,
         msa_tie_row_attn=m.msa_tie_row_attn,
+        msa_row_shard=m.msa_row_shard,
         context_parallel=m.context_parallel,
         use_flash=m.flash_attention,
         grid_parallel=m.grid_parallel,

@@ -99,3 +99,33 @@ def test_graft_dryrun_multichip():
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_msa_row_shard_tied_step_matches_single_device():
+    """model.msa_row_shard=True: MSA rows sharded P(dp, sp); the tied-row
+    logit contraction completes via an XLA-inserted psum over sp (SURVEY §7
+    "tied-rows becomes a collective"), with numbers identical to the
+    replicated single-device step."""
+    cfg = Config(
+        model=ModelConfig(dim=32, depth=1, heads=2, dim_head=16,
+                          max_seq_len=64, bfloat16=False,
+                          msa_tie_row_attn=True, msa_row_shard=True),
+        data=DataConfig(crop_len=16, msa_depth=8, msa_len=16, batch_size=2,
+                        min_len_filter=16),
+        train=TrainConfig(gradient_accumulate_every=1, warmup_steps=2),
+    )
+    batch = next(iter(SyntheticDataset(cfg.data, seed=5)))
+    model = build_model(cfg)
+
+    state1 = init_state(cfg, model, batch)
+    step1 = make_train_step(model, mesh=None)
+    s1, m1 = step1(state1, device_put_batch(batch), jax.random.key(13))
+
+    mesh = make_mesh(2, 4)  # 8 MSA rows over sp=4
+    state2 = init_state(cfg, model, batch)
+    step2 = make_train_step(model, mesh=mesh)
+    s2, m2 = step2(state2, device_put_batch(batch, mesh), jax.random.key(13))
+
+    assert np.isclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
