@@ -11,10 +11,12 @@ Design (not a port):
 
 - The coupling runs under ONE ``lax.scan`` over stacked per-depth parameters,
   wrapped in ``jax.custom_vjp``. Forward saves only the final carry; backward
-  scans the layers in reverse, reconstructing each layer's input by inversion
-  and re-running the layer under ``jax.vjp`` for its gradients. Activation
-  memory is O(1) in depth, like the reference — but the schedule is compiled
-  by XLA, not interpreted per-block by an autograd tape.
+  scans the layers in reverse, walking each layer's 8 additive updates
+  backwards — every sub-function is evaluated ONCE under a local ``jax.vjp``,
+  its output reused for both the inversion subtraction and the cotangent
+  pull. Activation memory is O(1) in depth and recompute cost is one extra
+  evaluation per sub-function, like the reference — but the schedule is
+  compiled by XLA, not interpreted per-block by an autograd tape.
 - The reference needs CUDA RNG state capture/replay (``Deterministic``,
   reversible.py:26-56) to make dropout recompute bit-exact. Stateless JAX
   PRNG keys make replay exact by construction: the same per-layer key is
