@@ -30,6 +30,8 @@ def _env_int(name: str, default: int) -> int:
 
 # flagship config; AF2TPU_BENCH_* env overrides allow small smoke runs on
 # hosts without an accelerator (the driver runs the defaults on TPU)
+_T0 = time.monotonic()
+
 CROP = _env_int("AF2TPU_BENCH_CROP", 256)
 MSA_DEPTH = _env_int("AF2TPU_BENCH_MSA_DEPTH", 16)
 MSA_LEN = _env_int("AF2TPU_BENCH_MSA_LEN", 256)
@@ -41,6 +43,22 @@ ITERS = _env_int("AF2TPU_BENCH_ITERS", 10)
 # steps chained in-graph per dispatch (lax.scan): isolates device throughput
 # from host/tunnel dispatch latency
 INGRAPH = _env_int("AF2TPU_BENCH_INGRAPH", 4)
+# total wall-clock budget (s): the bench must emit its JSON line before the
+# driver's own timeout would kill it with nothing on stdout (round 1 lost
+# both artifacts to rc=124). Healthy flagship runs finish in well under half
+# of this; a hung/flaky backend gets a diagnostic record instead of silence.
+# <= 0 disables the watchdog. Default leaves margin under the observed
+# >=30 min driver budget while tolerating a slow (~5 min) tunnel compile.
+DEADLINE = _env_int("AF2TPU_BENCH_DEADLINE", 1500)
+
+
+def _metric() -> str:
+    """One label for success and failure records — the driver correlates
+    records for the same config by this string."""
+    return (
+        f"residue-pairs/sec/chip crop={CROP} msa={MSA_DEPTH}x{MSA_LEN} "
+        f"dim={DIM} depth={DEPTH} batch={BATCH} fwd+bwd+opt"
+    )
 
 
 def main():
@@ -106,9 +124,10 @@ def main():
     mfu = _estimate_mfu(compiled, dt * INGRAPH)
 
     baseline_path = os.path.join(os.path.dirname(__file__), "bench_baseline.json")
+    # ATTEMPTS/DEADLINE tune retry/timeout infra, not the measured config
+    _infra = {"AF2TPU_BENCH_ATTEMPTS", "AF2TPU_BENCH_DEADLINE"}
     overridden = any(
-        k.startswith("AF2TPU_BENCH_") and k != "AF2TPU_BENCH_ATTEMPTS"
-        for k in os.environ  # ATTEMPTS retries infra, not the config
+        k.startswith("AF2TPU_BENCH_") and k not in _infra for k in os.environ
     )
     vs_baseline = 1.0
     compared = False
@@ -125,7 +144,7 @@ def main():
             compared = True
 
     record = {
-        "metric": f"residue-pairs/sec/chip crop={CROP} msa={MSA_DEPTH}x{MSA_LEN} dim={DIM} depth={DEPTH} batch={BATCH} fwd+bwd+opt",
+        "metric": _metric(),
         "value": round(pairs_per_sec, 1),
         "unit": "pairs/sec",
         "vs_baseline": round(vs_baseline, 3),
@@ -137,7 +156,7 @@ def main():
     }
     if mfu is not None:
         record["mfu"] = round(mfu, 4)
-    print(json.dumps(record))
+    _emit(record)
 
 
 # published peak dense bf16 FLOPs/s per chip (v5e's oft-quoted 394 is int8)
@@ -172,7 +191,55 @@ def _estimate_mfu(compiled, step_seconds):
         return None  # cost analysis is best-effort; never break the bench
 
 
+def _failure_record(msg: str) -> dict:
+    """Diagnostic record: value 0.0 + an ``error`` field is unambiguous
+    ("no measurement"), but stays parseable for the driver."""
+    return {
+        "metric": _metric(),
+        "value": 0.0,
+        "unit": "pairs/sec",
+        "vs_baseline": 0.0,
+        "vs_baseline_valid": False,
+        "error": msg,
+    }
+
+
+import threading
+
+_EMIT_LOCK = threading.Lock()
+_emitted = False
+
+
+def _emit(record: dict) -> None:
+    """Write the one JSON result line. First writer wins: the watchdog and
+    the main thread can race near the deadline, and the driver must never
+    see two records."""
+    global _emitted
+    with _EMIT_LOCK:
+        if _emitted:
+            return
+        _emitted = True
+        sys.stdout.write(json.dumps(record) + "\n")
+        sys.stdout.flush()
+
+
 if __name__ == "__main__":
+    import threading
+
+    def _watchdog():
+        # Backend init through the TPU tunnel can hang inside C++ with no
+        # timeout; a daemon thread + os._exit is the only escape that still
+        # gets a JSON line onto stdout before the driver's kill.
+        time.sleep(max(0.0, DEADLINE - (time.monotonic() - _T0)))
+        _emit(_failure_record(
+            f"deadline {DEADLINE}s exceeded (backend init hang or run too "
+            "slow); raise AF2TPU_BENCH_DEADLINE for bigger configs"
+        ))
+        os._exit(0)
+
+    if DEADLINE > 0:
+        threading.Thread(target=_watchdog, daemon=True).start()
+
     # the tunneled-TPU backend can fail transiently at INIT; retry a few
     # times before giving up so a single flaky window doesn't lose the run.
     # Only init failures are retryable: once a backend initializes, jax
@@ -184,8 +251,24 @@ if __name__ == "__main__":
             main()
             break
         except RuntimeError as e:
-            if "Unable to initialize backend" not in str(e) or i == attempts - 1:
+            if "Unable to initialize backend" not in str(e):
+                _emit(_failure_record(f"{type(e).__name__}: {e}"))
                 raise
+            remaining = (
+                DEADLINE - (time.monotonic() - _T0)
+                if DEADLINE > 0 else float("inf")
+            )
+            # a retry only helps if there is still time for the 60s backoff
+            # plus a realistic init (~4-5 min through the tunnel)
+            if i == attempts - 1 or remaining < 360:
+                _emit(_failure_record(
+                    f"backend init failed ({i + 1} attempt(s), "
+                    f"{remaining:.0f}s of {DEADLINE}s budget left): {e}"
+                ))
+                sys.exit(0)
             print(f"backend init unavailable (attempt {i + 1}/{attempts}); "
                   "retrying in 60s", file=sys.stderr)
             time.sleep(60)
+        except Exception as e:  # non-RuntimeError: still leave a record
+            _emit(_failure_record(f"{type(e).__name__}: {e}"))
+            raise
