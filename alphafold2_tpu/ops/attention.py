@@ -23,8 +23,10 @@ Design (not a port):
 - Memory-compressed cross-attention KV downsampling (alphafold2.py:100-137)
   uses a strided grouped conv (lax.conv via nn.Conv, feature_group_count =
   heads) with sum-pooled masks.
-- All masking is additive (large negative) with mask combination OR-free:
-  ``mask[..., :, None] & context_mask[..., None, :]``.
+- Masking is additive (large negative) with mask combination OR-free:
+  ``mask[..., :, None] & context_mask[..., None, :]``; the tied-row path
+  additionally zeroes padded q/k/v entries so they abstain from the shared
+  (row-summed) logits exactly.
 - Compute dtype is configurable (bfloat16 on TPU); params stay float32.
 """
 
@@ -63,7 +65,10 @@ class Attention(nn.Module):
     Feature parity with reference alphafold2.py:78-182:
     - ``context``/``context_mask`` for cross-attention
     - ``tie_dim``: fold a leading row axis (input (B*R, N, D)) into one shared
-      attention matrix with r^-0.5 scaling; masks must be all-true on tied rows
+      attention matrix with r^-0.5 scaling. Unlike the reference (which
+      forbids padding under tied rows, alphafold2.py:147-149), masks are
+      exact here: padded (row, position) entries abstain from the shared
+      logits and the row-count scale counts only voting rows
     - ``compress_ratio`` > 1: strided grouped-conv KV compression (cross only)
     """
 
@@ -223,12 +228,45 @@ class Attention(nn.Module):
             # (B*R, n, h, d) -> (B, R, n, h, d); one attention matrix per (B, h)
             r = tie_dim
             q, k, v = (t.reshape(-1, r, *t.shape[1:]) for t in (q, k, v))
-            dots = (
-                jnp.einsum("brihd,brjhd->bhij", q, k) * scale * (r**-0.5)
-            )
-            if mask is not None:
-                # tied rows forbid padding (reference alphafold2.py:147-149)
-                mask = None
+            tie_scale = r**-0.5
+            kv_side = context_mask if has_context else mask
+            if mask is not None or kv_side is not None:
+                # The reference hard-asserts tied rows never see padding
+                # (alphafold2.py:147-149). Here padding is exact instead:
+                # each padded (row, position) ABSTAINS from the shared
+                # logits (its q/k zeroed) and from the per-row output (its
+                # v zeroed), the row-count scale uses the number of rows
+                # that actually vote, and the softmax sees the shared
+                # column mask. For column padding (every row masks the same
+                # positions — what MSA length padding is) this equals
+                # attention on the cropped array; fully-masked rows are
+                # likewise exact (they abstain entirely). Query and kv
+                # sides are masked independently so tied cross-attention
+                # (broadcast context) works too.
+                bt, n, j = q.shape[0], q.shape[2], k.shape[2]
+                qr = (
+                    mask.reshape(bt, r, n)
+                    if mask is not None
+                    else jnp.ones((bt, r, n), dtype=bool)
+                )
+                kr = (
+                    kv_side.reshape(bt, r, j)
+                    if kv_side is not None
+                    else jnp.ones((bt, r, j), dtype=bool)
+                )
+                q = jnp.where(qr[..., None, None], q, 0)
+                k = jnp.where(kr[..., None, None], k, 0)
+                v = jnp.where(kr[..., None, None], v, 0)
+                # a row votes in the logit sum iff it has both a valid
+                # query and a valid key position
+                n_rows = jnp.maximum((qr.any(-1) & kr.any(-1)).sum(-1), 1)
+                tie_scale = (
+                    n_rows.astype(jnp.float32) ** -0.5
+                )[:, None, None, None].astype(self.dtype)
+                # shared masks for the softmax below (batch dim B, not B*R)
+                mask = qr.any(1)
+                context_mask = kr.any(1) if has_context else None
+            dots = jnp.einsum("brihd,brjhd->bhij", q, k) * scale * tie_scale
         else:
             dots = jnp.einsum("bihd,bjhd->bhij", q, k) * scale
 
