@@ -272,6 +272,12 @@ class Trunk(nn.Module):
                 "(its MSA streams are replicated); use remat=True to "
                 "combine MSA-row sharding with O(1) activation memory"
             )
+            assert not self.grid_parallel, (
+                "grid_parallel is not supported by the reversible engine "
+                "(its axial passes run dense, so the 2D-sharded pair state "
+                "would be all-gathered and the memory benefit silently "
+                "lost); use remat=True with grid_parallel"
+            )
             return ReversibleTrunk(
                 dim=self.dim,
                 depth=self.depth,

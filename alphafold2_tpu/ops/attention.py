@@ -67,8 +67,12 @@ class Attention(nn.Module):
     - ``tie_dim``: fold a leading row axis (input (B*R, N, D)) into one shared
       attention matrix with r^-0.5 scaling. Unlike the reference (which
       forbids padding under tied rows, alphafold2.py:147-149), masks are
-      exact here: padded (row, position) entries abstain from the shared
-      logits and the row-count scale counts only voting rows
+      handled here: padded (row, position) entries abstain from the shared
+      logits and the row-count scale counts only voting rows. This equals
+      attention on the cropped array when rows agree on masked positions
+      (column padding — what MSA length padding is — and fully-masked
+      rows); genuinely ragged per-row masks degrade gracefully (masked
+      entries abstain) but have no cropped-array equivalent
     - ``compress_ratio`` > 1: strided grouped-conv KV compression (cross only)
     """
 

@@ -142,6 +142,15 @@ def main():
         if base.get("value") and base.get("ingraph") == INGRAPH:
             vs_baseline = pairs_per_sec / base["value"]
             compared = True
+        elif base.get("value"):
+            print(
+                f"WARNING: bench_baseline.json was recorded with "
+                f"ingraph={base.get('ingraph')} but this run uses "
+                f"ingraph={INGRAPH}; regression detection is DISARMED "
+                "(vs_baseline=1.0 means 'not compared'). Re-record the "
+                "baseline on TPU to re-arm.",
+                file=sys.stderr,
+            )
 
     record = {
         "metric": _metric(),

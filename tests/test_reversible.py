@@ -183,6 +183,20 @@ def test_reversible_requires_msa():
         t.init(jax.random.key(0), x, None)
 
 
+def test_reversible_rejects_grid_parallel():
+    # the reversible engine's axial passes run dense: combining it with the
+    # 2D pair-grid sharding would silently all-gather the pair state and
+    # lose the memory benefit — must refuse, like context_parallel does
+    from alphafold2_tpu.models.trunk import Trunk
+
+    x = jnp.zeros((1, 4, 4, D))
+    m = jnp.zeros((1, 2, 4, D))
+    t = Trunk(dim=D, depth=1, heads=2, dim_head=8, reversible=True,
+              grid_parallel=True)
+    with pytest.raises(AssertionError, match="grid_parallel"):
+        t.init(jax.random.key(0), x, m)
+
+
 def test_reversible_with_sparse_attention():
     """Composition: block-sparse pair attention (its own custom-vjp Pallas
     path) inside the reversible engine's hand-scheduled backward. Values and
