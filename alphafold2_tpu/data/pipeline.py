@@ -115,7 +115,7 @@ class SidechainnetDataset:
     def __init__(self, config: DataConfig, seed: int = 0):
         try:
             import sidechainnet as scn
-        except ImportError as e:  # pragma: no cover - env-dependent
+        except ImportError as e:
             raise ImportError(
                 "sidechainnet is not installed; use source='synthetic'"
             ) from e
@@ -129,7 +129,7 @@ class SidechainnetDataset:
             dynamic_batching=False,
         )
 
-    def __iter__(self):  # pragma: no cover - env-dependent
+    def __iter__(self):
         cfg = self.config
         rng = np.random.default_rng(self.seed)
         L, M, NM, B = cfg.crop_len, cfg.msa_depth, cfg.msa_len, cfg.batch_size

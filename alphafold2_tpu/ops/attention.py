@@ -41,6 +41,27 @@ from flax import linen as nn
 MASK_VALUE = -1e9
 
 
+def grid_axial_project_attend(
+    to_q, to_kv, to_out, heads, dim_head, x, mask, attend_axis, attn_fn
+):
+    """Shared grid_axial body for Attention and SparseAttention: pointwise
+    q/kv projections on the local shard, one 2D-sharded axial pass (with the
+    module's fused per-device kernel), output projection."""
+    from alphafold2_tpu.parallel.grid_parallel import grid_axial_attention
+    from alphafold2_tpu.parallel.sharding import active_mesh
+
+    b, gh, gw, _ = x.shape
+    q = to_q(x).reshape(b, gh, gw, heads, dim_head)
+    k, v = jnp.split(to_kv(x), 2, axis=-1)
+    k = k.reshape(b, gh, gw, heads, dim_head)
+    v = v.reshape(b, gh, gw, heads, dim_head)
+    out = grid_axial_attention(
+        q, k, v, mask=mask, mesh=active_mesh(), attend_axis=attend_axis,
+        attn_fn=attn_fn,
+    )
+    return to_out(out.reshape(b, gh, gw, heads * dim_head))
+
+
 class FeedForward(nn.Module):
     """GEGLU feedforward: Linear(d -> 2*mult*d) -> gated GELU -> Linear(mult*d -> d)."""
 
@@ -101,25 +122,37 @@ class Attention(nn.Module):
                 dtype=self.dtype,
             )
 
+    def _use_flash(self) -> bool:
+        """One place for the None -> auto-on-TPU flash policy (both the flat
+        __call__ path and grid_axial consult it)."""
+        if self.use_flash is None:
+            from alphafold2_tpu.ops.flash import flash_available
+
+            return flash_available()
+        return self.use_flash
+
     def grid_axial(self, x, mask=None, attend_axis: int = 2):
         """Self-attention along ONE axis of a (B, H, W, D) grid with the grid
         2D-sharded over a (dp, spr, spc) mesh (parallel/grid_parallel.py):
         projections are pointwise and run on the local shard; the attended
-        axis is gathered by an all-to-all inside the primitive. Exact dense
-        attention; no tied rows / compression / broadcast context here."""
-        from alphafold2_tpu.parallel.grid_parallel import grid_axial_attention
-        from alphafold2_tpu.parallel.sharding import active_mesh
+        axis is gathered by an all-to-all inside the primitive. On TPU the
+        per-device attended-axis pass runs the fused flash kernel (falling
+        back to exact dense attention); no tied rows / compression /
+        broadcast context here."""
+        dh = self.dim_head
+        attn_fn = None
+        if self._use_flash():
+            from alphafold2_tpu.ops.flash import flash_attention
 
-        h, dh = self.heads, self.dim_head
-        b, gh, gw, _ = x.shape
-        q = self.to_q(x).reshape(b, gh, gw, h, dh)
-        k, v = jnp.split(self.to_kv(x), 2, axis=-1)
-        k = k.reshape(b, gh, gw, h, dh)
-        v = v.reshape(b, gh, gw, h, dh)
-        out = grid_axial_attention(
-            q, k, v, mask=mask, mesh=active_mesh(), attend_axis=attend_axis,
+            def attn_fn(q2, k2, v2, m2):
+                return flash_attention(
+                    q2, k2, v2, q_mask=m2, kv_mask=m2, sm_scale=dh**-0.5
+                )
+
+        return grid_axial_project_attend(
+            self.to_q, self.to_kv, self.to_out, self.heads, dh,
+            x, mask, attend_axis, attn_fn,
         )
-        return self.to_out(out.reshape(b, gh, gw, h * dh))
 
     def __call__(
         self,
@@ -209,12 +242,7 @@ class Attention(nn.Module):
 
         # fused flash-attention path (TPU): the (n, n) attention matrix stays
         # in VMEM instead of HBM.
-        use_flash = self.use_flash
-        if use_flash is None:
-            from alphafold2_tpu.ops.flash import flash_available
-
-            use_flash = flash_available()
-        if use_flash and plain_softmax:
+        if self._use_flash() and plain_softmax:
             from alphafold2_tpu.ops.flash import flash_attention
 
             out = flash_attention(
@@ -372,12 +400,16 @@ class AxialAttention(nn.Module):
 
             mesh = active_mesh()
             if mesh is not None and ROW_AXIS_NAME in mesh.axis_names:
-                assert context is None and not self.tie_row_attn and (
-                    not self.sparse_attn
-                ), "grid_parallel axial attention is the plain self-attn path"
+                assert context is None and not self.tie_row_attn, (
+                    "grid_parallel axial attention is self-attention only "
+                    "(no broadcast context, no tied rows — neither occurs "
+                    "on the pair stream)"
+                )
                 # same two passes, each over the 2D-sharded grid:
                 # attn_width attends within columns (over rows, axis 1),
-                # attn_height within rows (over columns, axis 2)
+                # attn_height within rows (over columns, axis 2); each
+                # Attention/SparseAttention supplies its fused per-device
+                # kernel (flash / block-sparse) via grid_axial
                 w_out = attn_width.grid_axial(x, mask=mask, attend_axis=1)
                 h_out = attn_height.grid_axial(x, mask=mask, attend_axis=2)
                 return w_out + h_out

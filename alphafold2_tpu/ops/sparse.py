@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from alphafold2_tpu.ops.attention import MASK_VALUE
+from alphafold2_tpu.ops.attention import MASK_VALUE, grid_axial_project_attend
 
 
 @dataclasses.dataclass(frozen=True)
@@ -219,7 +219,52 @@ class SparseAttention(nn.Module):
     use_pallas: Optional[bool] = None  # None -> Pallas kernel on TPU backends
     dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
+    def setup(self):
+        inner = self.heads * self.dim_head
+        self.to_q = nn.Dense(inner, use_bias=False, dtype=self.dtype)
+        self.to_kv = nn.Dense(inner * 2, use_bias=False, dtype=self.dtype)
+        self.to_out = nn.Dense(self.dim, dtype=self.dtype)
+        self.out_dropout = nn.Dropout(self.dropout)
+
+    def _impl(self):
+        use_pallas = self.use_pallas
+        if use_pallas is None:
+            use_pallas = jax.default_backend() == "tpu"
+        return (
+            block_sparse_attention_pallas
+            if use_pallas
+            else block_sparse_attention
+        )
+
+    def grid_axial(self, x, mask=None, attend_axis: int = 2):
+        """Block-sparse self-attention along ONE axis of a (B, H, W, D) grid
+        2D-sharded over a (dp, spr, spc) mesh: after the all-to-all gathers
+        the full attended axis per device, the local pass runs this module's
+        block-sparse kernel instead of dense attention — O(N * active_blocks
+        * block) logits per device, which is what makes 768+-crop grids fit
+        (parallel/grid_parallel.py)."""
+        h, dh = self.heads, self.dim_head
+        n_att = x.shape[attend_axis]
+        bs = self.config.block_size
+        assert n_att % bs == 0, (
+            f"grid-sharded sparse attention needs the attended axis "
+            f"({n_att}) to be a multiple of block_size ({bs})"
+        )
+        if self.seq_len is not None:
+            assert n_att <= self.seq_len, (
+                f"attended axis {n_att} exceeds max_seq_len {self.seq_len}"
+            )
+        layout = self.config.layout(n_att)
+        impl = self._impl()
+
+        def attn_fn(q2, k2, v2, m2):
+            return impl(q2, k2, v2, layout, bs, mask=m2)
+
+        return grid_axial_project_attend(
+            self.to_q, self.to_kv, self.to_out, h, dh,
+            x, mask, attend_axis, attn_fn,
+        )
+
     def __call__(
         self,
         x,
@@ -250,25 +295,17 @@ class SparseAttention(nn.Module):
         if pad:
             mask = jnp.pad(mask, ((0, 0), (0, pad)))
 
-        q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
-        kv = nn.Dense(inner * 2, use_bias=False, dtype=self.dtype, name="to_kv")(x)
-        k, v = jnp.split(kv, 2, axis=-1)
+        q = self.to_q(x)
+        k, v = jnp.split(self.to_kv(x), 2, axis=-1)
 
         def heads_first(t):
             return jnp.moveaxis(t.reshape(b, padded_n, h, dh), 2, 1)
 
         q, k, v = heads_first(q), heads_first(k), heads_first(v)
         layout = self.config.layout(padded_n)
-
-        use_pallas = self.use_pallas
-        if use_pallas is None:
-            use_pallas = jax.default_backend() == "tpu"
-        if use_pallas:
-            out = block_sparse_attention_pallas(q, k, v, layout, bs, mask=mask)
-        else:
-            out = block_sparse_attention(q, k, v, layout, bs, mask=mask)
+        out = self._impl()(q, k, v, layout, bs, mask=mask)
 
         out = jnp.moveaxis(out, 1, 2).reshape(b, padded_n, inner)
-        out = nn.Dense(self.dim, dtype=self.dtype, name="to_out")(out)
-        out = nn.Dropout(self.dropout)(out, deterministic=deterministic)
+        out = self.to_out(out)
+        out = self.out_dropout(out, deterministic=deterministic)
         return out[:, :n]

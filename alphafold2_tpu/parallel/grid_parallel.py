@@ -71,19 +71,39 @@ def grid_spec() -> P:
     return P(DATA_AXIS_NAME, ROW_AXIS_NAME, COL_AXIS_NAME)
 
 
-def _attend_last_grid_axis(q, k, v, bias):
-    """Dense attention over grid axis 2. q/k/v: (B, R, N, H, D); bias:
-    (B, R, N) additive key bias. Rows R are independent batch entries."""
-    scale = q.shape[-1] ** -0.5
+def _attend_last_grid_axis(q, k, v, mask, attn_fn=None):
+    """Attention over grid axis 2. q/k/v: (B, R, N, H, D); mask: (B, R, N)
+    bool key validity. Rows R are independent batch entries.
+
+    ``attn_fn`` is an optional fused kernel taking row-flattened
+    ``(B*R, H, N, D)`` q/k/v and a ``(B*R, N)`` mask (or None), returning
+    the attended values in the same layout — or None to decline the shape
+    (trace-time), falling back to the dense jnp path. This is how flash /
+    block-sparse attention run INSIDE the 2D-sharded axial passes.
+
+    ``mask=None`` stays None all the way down so fused kernels keep their
+    unmasked fast paths (e.g. flash without SegmentIds)."""
+    b, r, n, h, d = q.shape
+    if attn_fn is not None:
+        def flat(t):  # (B, R, N, H, D) -> (B*R, H, N, D)
+            return jnp.moveaxis(t.reshape(b * r, n, h, d), 2, 1)
+
+        m2 = mask.reshape(b * r, n) if mask is not None else None
+        out = attn_fn(flat(q), flat(k), flat(v), m2)
+        if out is not None:
+            return jnp.moveaxis(out, 1, 2).reshape(b, r, n, h, d)
+    scale = d**-0.5
     dots = jnp.einsum("brihd,brjhd->brhij", q, k).astype(jnp.float32) * scale
-    dots = dots + bias[:, :, None, None, :].astype(jnp.float32)
+    if mask is not None:
+        bias = jnp.where(mask, 0.0, MASK_VALUE)
+        dots = dots + bias[:, :, None, None, :].astype(jnp.float32)
     attn = jax.nn.softmax(dots, axis=-1).astype(q.dtype)
     return jnp.einsum("brhij,brjhd->brihd", attn, v)
 
 
-def _sharded_pass(q, k, v, bias, attend_axis: int):
+def _sharded_pass(q, k, v, mask, attend_axis: int, attn_fn=None):
     """Runs inside shard_map over (dp, spr, spc). Local blocks:
-    q/k/v (b, hl, wl, heads, d), bias (b, hl, wl)."""
+    q/k/v (b, hl, wl, heads, d), mask (b, hl, wl) or None."""
     if attend_axis == 2:
         gather_name, split_axis = COL_AXIS_NAME, 1
     elif attend_axis == 1:
@@ -109,11 +129,13 @@ def _sharded_pass(q, k, v, bias, attend_axis: int):
             tiled=True,
         )
 
-    q, k, v, bias = gather(q), gather(k), gather(v), gather(bias)
+    q, k, v = gather(q), gather(k), gather(v)
+    if mask is not None:
+        mask = gather(mask)
     if attend_axis == 1:  # put the attended axis last for the shared kernel
         q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
-        bias = jnp.swapaxes(bias, 1, 2)
-    out = _attend_last_grid_axis(q, k, v, bias)
+        mask = jnp.swapaxes(mask, 1, 2) if mask is not None else None
+    out = _attend_last_grid_axis(q, k, v, mask, attn_fn=attn_fn)
     if attend_axis == 1:
         out = jnp.swapaxes(out, 1, 2)
     return scatter(out)
@@ -126,34 +148,46 @@ def grid_axial_attention(
     mask: Optional[jnp.ndarray] = None,  # (B, H, W) bool key-validity
     mesh: Optional[Mesh] = None,
     attend_axis: int = 2,
+    attn_fn=None,  # fused kernel hook, see _attend_last_grid_axis
 ) -> jnp.ndarray:
     """One axial attention pass over a 2D-sharded grid.
 
     ``attend_axis=2`` attends within rows (over columns), ``attend_axis=1``
     within columns (over rows) — call twice and sum for the full axial
     block (ops/attention.py AxialAttention semantics). Exact dense
-    attention in both the sharded and meshless paths.
+    attention in both the sharded and meshless paths; ``attn_fn`` swaps the
+    per-device attended-axis computation for a fused kernel (flash /
+    block-sparse) after the all-to-all gather.
     """
-    b, hgrid, wgrid = q.shape[:3]
-    bias = (
-        jnp.where(mask, 0.0, MASK_VALUE).astype(jnp.float32)
-        if mask is not None
-        else jnp.zeros((b, hgrid, wgrid), jnp.float32)
-    )
     if mesh is None or ROW_AXIS_NAME not in mesh.axis_names:
         if attend_axis == 1:
             qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
-            out = _attend_last_grid_axis(qt, kt, vt, jnp.swapaxes(bias, 1, 2))
+            mt = jnp.swapaxes(mask, 1, 2) if mask is not None else None
+            out = _attend_last_grid_axis(qt, kt, vt, mt, attn_fn=attn_fn)
             return jnp.swapaxes(out, 1, 2)
-        return _attend_last_grid_axis(q, k, v, bias)
+        return _attend_last_grid_axis(q, k, v, mask, attn_fn=attn_fn)
 
     qkv_spec = P(DATA_AXIS_NAME, ROW_AXIS_NAME, COL_AXIS_NAME, None, None)
-    bias_spec = P(DATA_AXIS_NAME, ROW_AXIS_NAME, COL_AXIS_NAME)
+    mask_spec = P(DATA_AXIS_NAME, ROW_AXIS_NAME, COL_AXIS_NAME)
+    if mask is None:
+        # mask stays None down to the per-device kernels (their unmasked
+        # fast paths) — shard_map over the three tensor inputs only
+        mapped = shard_map(
+            partial(
+                _sharded_pass, mask=None, attend_axis=attend_axis,
+                attn_fn=attn_fn,
+            ),
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )
+        return mapped(q, k, v)
     mapped = shard_map(
-        partial(_sharded_pass, attend_axis=attend_axis),
+        partial(_sharded_pass, attend_axis=attend_axis, attn_fn=attn_fn),
         mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, bias_spec),
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec,
         check_vma=False,
     )
-    return mapped(q, k, v, bias)
+    return mapped(q, k, v, mask)

@@ -119,6 +119,118 @@ def test_grid_train_step_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.parametrize("attend_axis", [1, 2])
+def test_attn_fn_hook_runs_inside_sharded_pass(attend_axis):
+    """The fused-kernel hook must actually execute per device after the
+    all-to-all gather: an exact jnp reimplementation fed through the hook
+    reproduces the dense path, and a sentinel (zeros) proves it ran."""
+    q, k, v = _qkv(jax.random.key(5))
+    mask = _mask()
+    mesh = make_grid_mesh(2, 2, 2)
+
+    def exact(q2, k2, v2, m2):  # (B2, H, N, D) + (B2, N), like flash/sparse
+        dots = jnp.einsum("bhid,bhjd->bhij", q2, k2) * q2.shape[-1] ** -0.5
+        dots = jnp.where(m2[:, None, None, :], dots, -1e9)
+        return jnp.einsum("bhij,bhjd->bhid", jax.nn.softmax(dots, -1), v2)
+
+    dense = grid_axial_attention(q, k, v, mask, mesh=None,
+                                 attend_axis=attend_axis)
+    hooked = jax.jit(
+        lambda q, k, v: grid_axial_attention(
+            q, k, v, mask, mesh=mesh, attend_axis=attend_axis, attn_fn=exact
+        )
+    )(q, k, v)
+    valid = np.asarray(mask)[..., None, None]
+    np.testing.assert_allclose(
+        np.asarray(hooked) * valid, np.asarray(dense) * valid, atol=2e-5
+    )
+
+    sentinel = jax.jit(
+        lambda q, k, v: grid_axial_attention(
+            q, k, v, mask, mesh=mesh, attend_axis=attend_axis,
+            attn_fn=lambda q2, k2, v2, m2: jnp.zeros_like(q2),
+        )
+    )(q, k, v)
+    np.testing.assert_array_equal(np.asarray(sentinel), 0.0)
+
+
+def test_attn_fn_decline_falls_back_dense():
+    # a hook returning None (flash declining the shape) must leave the
+    # dense result untouched
+    q, k, v = _qkv(jax.random.key(6))
+    mesh = make_grid_mesh(2, 2, 2)
+    dense = jax.jit(
+        lambda q, k, v: grid_axial_attention(q, k, v, mesh=mesh)
+    )(q, k, v)
+    declined = jax.jit(
+        lambda q, k, v: grid_axial_attention(
+            q, k, v, mesh=mesh, attn_fn=lambda *a: None
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(declined), np.asarray(dense))
+
+
+def test_sparse_axial_in_grid_matches_meshless():
+    """AxialAttention(sparse_attn=True, grid_parallel=True): the 2D-sharded
+    passes run the block-sparse kernel per device after the gather, and the
+    values match the same module without a mesh (VERDICT round-1 #7)."""
+    from alphafold2_tpu.ops.attention import AxialAttention
+    from alphafold2_tpu.ops.sparse import BlockSparseConfig
+    from alphafold2_tpu.parallel.sharding import use_mesh
+
+    n = 16  # grid 16x16, block 4 -> 4 blocks per attended axis
+    cfg = BlockSparseConfig(
+        block_size=4, num_local_blocks=2, num_global_blocks=1,
+        num_random_blocks=1,
+    )
+    mod = AxialAttention(
+        dim=16, heads=2, dim_head=8, sparse_attn=True, seq_len=n,
+        sparse_config=cfg, sparse_use_pallas=False, grid_parallel=True,
+    )
+    x = jax.random.normal(jax.random.key(7), (2, n, n, 16))
+    mask = jnp.ones((2, n, n), bool).at[:, :, -2:].set(False)
+    params = mod.init(jax.random.key(8), x, mask=mask)
+
+    meshless = mod.apply(params, x, mask=mask)
+    mesh = make_grid_mesh(2, 2, 2)
+    with use_mesh(mesh):
+        sharded = jax.jit(lambda x: mod.apply(params, x, mask=mask))(x)
+    valid = np.asarray(mask)[..., None]
+    np.testing.assert_allclose(
+        np.asarray(sharded) * valid, np.asarray(meshless) * valid, atol=2e-5
+    )
+
+
+def test_sparse_grid_768_crop_step():
+    """The 768-crop story (grid_parallel.py module docstring): one sparse
+    axial pass over a (1, 768, 768) grid on the 8-virtual-device mesh.
+    Dense logits for one pass would be 768^2 * 768 * 4B ~ 1.7TB — only the
+    block-sparse per-device path makes this executable at all here."""
+    from alphafold2_tpu.ops.attention import AxialAttention
+    from alphafold2_tpu.ops.sparse import BlockSparseConfig
+    from alphafold2_tpu.parallel.sharding import use_mesh
+
+    n = 768
+    cfg = BlockSparseConfig(
+        block_size=128, num_local_blocks=2, num_global_blocks=1,
+        num_random_blocks=0,
+    )
+    mod = AxialAttention(
+        dim=8, heads=1, dim_head=8, sparse_attn=True, seq_len=n,
+        sparse_config=cfg, sparse_use_pallas=False, grid_parallel=True,
+    )
+    x = jax.random.normal(jax.random.key(9), (1, n, n, 8), jnp.float32)
+    mesh = make_grid_mesh(1, 2, 4)
+    with use_mesh(mesh):
+        params = jax.eval_shape(lambda: mod.init(jax.random.key(10), x))
+        params = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), params
+        )
+        out = jax.jit(lambda x: mod.apply(params, x))(x)
+    assert out.shape == (1, n, n, 8)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
 def test_indivisible_axis_raises():
     # N/spr = 4 rows per device, spc = 2 -> fine; but N=6 local rows 3 is
     # not divisible by spc=2 for the transpose
