@@ -42,11 +42,18 @@ MASK_VALUE = -1e9
 
 
 def grid_axial_project_attend(
-    to_q, to_kv, to_out, heads, dim_head, x, mask, attend_axis, attn_fn
+    to_q, to_kv, to_out, heads, dim_head, x, mask, attend_axis, attn_fn,
+    sharded,
 ):
     """Shared grid_axial body for Attention and SparseAttention: pointwise
-    q/kv projections on the local shard, one 2D-sharded axial pass (with the
-    module's fused per-device kernel), output projection."""
+    q/kv projections on the (possibly sharded) grid, one axial pass with
+    the module's fused per-device kernel, output projection.
+
+    ``sharded=True`` runs the pass as an explicit shard_map over an active
+    (dp, spr, spc) mesh — correct ONLY for arrays laid out P(dp, spr, spc)
+    (the pair stream under grid_parallel). ``sharded=False`` runs the
+    meshless grid-native formulation; under jit, GSPMD handles whatever
+    sharding the array actually has (e.g. the MSA stream)."""
     from alphafold2_tpu.parallel.grid_parallel import grid_axial_attention
     from alphafold2_tpu.parallel.sharding import active_mesh
 
@@ -56,8 +63,8 @@ def grid_axial_project_attend(
     k = k.reshape(b, gh, gw, heads, dim_head)
     v = v.reshape(b, gh, gw, heads, dim_head)
     out = grid_axial_attention(
-        q, k, v, mask=mask, mesh=active_mesh(), attend_axis=attend_axis,
-        attn_fn=attn_fn,
+        q, k, v, mask=mask, mesh=active_mesh() if sharded else None,
+        attend_axis=attend_axis, attn_fn=attn_fn,
     )
     return to_out(out.reshape(b, gh, gw, heads * dim_head))
 
@@ -131,14 +138,16 @@ class Attention(nn.Module):
             return flash_available()
         return self.use_flash
 
-    def grid_axial(self, x, mask=None, attend_axis: int = 2):
-        """Self-attention along ONE axis of a (B, H, W, D) grid with the grid
-        2D-sharded over a (dp, spr, spc) mesh (parallel/grid_parallel.py):
-        projections are pointwise and run on the local shard; the attended
-        axis is gathered by an all-to-all inside the primitive. On TPU the
-        per-device attended-axis pass runs the fused flash kernel (falling
-        back to exact dense attention); no tied rows / compression /
-        broadcast context here."""
+    def grid_axial(self, x, mask=None, attend_axis: int = 2,
+                   sharded: bool = True):
+        """Self-attention along ONE axis of a (B, H, W, D) grid. With
+        ``sharded=True`` and an active (dp, spr, spc) mesh the grid is
+        2D-sharded (parallel/grid_parallel.py): projections are pointwise
+        and run on the local shard; the attended axis is gathered by an
+        all-to-all inside the primitive. On TPU the per-device
+        attended-axis pass runs the fused flash kernel (falling back to
+        exact dense attention); no tied rows / compression / broadcast
+        context here."""
         dh = self.dim_head
         attn_fn = None
         if self._use_flash():
@@ -151,7 +160,7 @@ class Attention(nn.Module):
 
         return grid_axial_project_attend(
             self.to_q, self.to_kv, self.to_out, self.heads, dh,
-            x, mask, attend_axis, attn_fn,
+            x, mask, attend_axis, attn_fn, sharded,
         )
 
     def __call__(
@@ -351,6 +360,8 @@ class AxialAttention(nn.Module):
     sparse_use_pallas: Optional[bool] = None  # None -> auto (Pallas on TPU)
     use_flash: Optional[bool] = None  # dense path: fused kernel on TPU
     grid_parallel: bool = False  # 2D-sharded passes over a (dp, spr, spc) mesh
+    grid_native: bool = True  # grid-layout self-attn passes (no pair-map
+    # transpose materialization); False forces the flat (B*, n, d) route
     dtype: jnp.dtype = jnp.float32
 
     def _attn_cls(self, name):
@@ -391,10 +402,8 @@ class AxialAttention(nn.Module):
         attn_width = self._attn_cls("attn_width")
         attn_height = self._attn_cls("attn_height")
 
-        # the grid primitive has no attention-weight dropout; with active
-        # dropout fall through to the regular path rather than silently
-        # dropping the regularization
-        if self.grid_parallel and (self.dropout == 0.0 or deterministic):
+        grid_mesh_active = False
+        if self.grid_parallel:
             from alphafold2_tpu.parallel.grid_parallel import ROW_AXIS_NAME
             from alphafold2_tpu.parallel.sharding import active_mesh
 
@@ -405,14 +414,56 @@ class AxialAttention(nn.Module):
                     "(no broadcast context, no tied rows — neither occurs "
                     "on the pair stream)"
                 )
-                # same two passes, each over the 2D-sharded grid:
-                # attn_width attends within columns (over rows, axis 1),
-                # attn_height within rows (over columns, axis 2); each
-                # Attention/SparseAttention supplies its fused per-device
-                # kernel (flash / block-sparse) via grid_axial
-                w_out = attn_width.grid_axial(x, mask=mask, attend_axis=1)
-                h_out = attn_height.grid_axial(x, mask=mask, attend_axis=2)
-                return w_out + h_out
+                grid_mesh_active = True
+
+        # Grid route: q/kv/out projections stay pointwise on the
+        # (B, H, W, D) grid — the flat route instead materializes a
+        # transpose of the whole pair map for the column pass, a full extra
+        # HBM round-trip per axial block. Each pass runs the module's fused
+        # per-device kernel (flash / block-sparse); with grid_parallel and
+        # an active (dp, spr, spc) mesh it is the explicit 2D-sharded
+        # shard_map pass. Constraints: self-attention only, untied, no
+        # active attention-weight dropout (the fused kernels never
+        # materialize probabilities), and block-aligned axes for sparse
+        # layouts. grid_native=False is a debug escape back to the flat
+        # route — but never under an active grid mesh, where the flat
+        # route's transpose of the 2D-sharded pair map would be a silent
+        # memory/perf cliff.
+        grid_ok = (
+            (self.grid_native or grid_mesh_active)
+            and context is None
+            and not self.tie_row_attn
+            and (self.dropout == 0.0 or deterministic)
+        )
+        if grid_ok and self.sparse_attn:
+            from alphafold2_tpu.ops.sparse import BlockSparseConfig
+
+            bs = (self.sparse_config or BlockSparseConfig()).block_size
+            aligned = height % bs == 0 and w % bs == 0
+            if grid_mesh_active and not aligned:
+                # meshless flat sparse pads unaligned crops, but there is
+                # no sharded flat route — refuse rather than silently
+                # running unsharded at the crop sizes grid_parallel targets
+                raise ValueError(
+                    f"grid_parallel sparse attention needs block-aligned "
+                    f"grid axes: ({height}, {w}) vs block_size {bs}; pad "
+                    "the crop or change sparse_config.block_size"
+                )
+            grid_ok = aligned
+        if grid_ok:
+            # attn_width attends within columns (over rows, axis 1),
+            # attn_height within rows (over columns, axis 2). Only the
+            # grid_parallel pair stream is laid out P(dp, spr, spc) —
+            # everything else (e.g. the MSA grid) must NOT enter the
+            # explicit shard_map and relies on GSPMD instead.
+            sharded = grid_mesh_active
+            w_out = attn_width.grid_axial(
+                x, mask=mask, attend_axis=1, sharded=sharded
+            )
+            h_out = attn_height.grid_axial(
+                x, mask=mask, attend_axis=2, sharded=sharded
+            )
+            return w_out + h_out
 
         def broadcast_ctx(n_batch):
             if context is None:

@@ -236,7 +236,8 @@ class SparseAttention(nn.Module):
             else block_sparse_attention
         )
 
-    def grid_axial(self, x, mask=None, attend_axis: int = 2):
+    def grid_axial(self, x, mask=None, attend_axis: int = 2,
+                   sharded: bool = True):
         """Block-sparse self-attention along ONE axis of a (B, H, W, D) grid
         2D-sharded over a (dp, spr, spc) mesh: after the all-to-all gathers
         the full attended axis per device, the local pass runs this module's
@@ -262,7 +263,7 @@ class SparseAttention(nn.Module):
 
         return grid_axial_project_attend(
             self.to_q, self.to_kv, self.to_out, h, dh,
-            x, mask, attend_axis, attn_fn,
+            x, mask, attend_axis, attn_fn, sharded,
         )
 
     def __call__(

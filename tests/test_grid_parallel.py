@@ -231,6 +231,76 @@ def test_sparse_grid_768_crop_step():
     assert np.all(np.isfinite(np.asarray(out)))
 
 
+@pytest.mark.parametrize("sparse", [False, True])
+def test_grid_native_matches_flat_route(sparse):
+    """The default grid-native axial route (pointwise projections on the
+    grid, no pair-map transpose materialization) computes the same values
+    as the flat (B*, n, d) route on the valid region, dense and sparse."""
+    from alphafold2_tpu.ops.attention import AxialAttention
+    from alphafold2_tpu.ops.sparse import BlockSparseConfig
+
+    n = 8
+    kw = dict(dim=16, heads=2, dim_head=8)
+    if sparse:
+        kw.update(
+            sparse_attn=True, seq_len=n, sparse_use_pallas=False,
+            sparse_config=BlockSparseConfig(
+                block_size=4, num_local_blocks=2, num_global_blocks=1,
+                num_random_blocks=0,
+            ),
+        )
+    a = AxialAttention(**kw, grid_native=True)
+    b_mod = AxialAttention(**kw, grid_native=False)
+    x = jax.random.normal(jax.random.key(11), (2, n, n, 16))
+    mask = jnp.ones((2, n, n), bool).at[:, :, -2:].set(False)
+    params = a.init(jax.random.key(12), x, mask=mask)
+
+    out_grid = a.apply(params, x, mask=mask)
+    out_flat = b_mod.apply(params, x, mask=mask)
+    valid = np.asarray(mask)[..., None]
+    np.testing.assert_allclose(
+        np.asarray(out_grid) * valid, np.asarray(out_flat) * valid,
+        atol=2e-5,
+    )
+
+
+def test_grid_mesh_overrides_grid_native_escape():
+    """grid_native=False is a flat-route debug escape, but under an active
+    grid mesh the sharded pass must still run (the flat route would
+    transpose the 2D-sharded pair map — a silent memory cliff)."""
+    from alphafold2_tpu.ops.attention import AxialAttention
+    from alphafold2_tpu.parallel.sharding import use_mesh
+
+    n = 8
+    mod = AxialAttention(dim=16, heads=2, dim_head=8, grid_parallel=True,
+                         grid_native=False)
+    x = jax.random.normal(jax.random.key(13), (2, n, n, 16))
+    params = mod.init(jax.random.key(14), x)
+    ref = mod.apply(params, x)
+    mesh = make_grid_mesh(2, 2, 2)
+    with use_mesh(mesh):
+        out = jax.jit(lambda x: mod.apply(params, x))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_grid_sparse_unaligned_fails_loudly():
+    from alphafold2_tpu.ops.attention import AxialAttention
+    from alphafold2_tpu.ops.sparse import BlockSparseConfig
+    from alphafold2_tpu.parallel.sharding import use_mesh
+
+    n = 12  # not a multiple of block_size 8
+    mod = AxialAttention(
+        dim=16, heads=2, dim_head=8, sparse_attn=True, seq_len=16,
+        sparse_use_pallas=False, grid_parallel=True,
+        sparse_config=BlockSparseConfig(block_size=8),
+    )
+    x = jax.random.normal(jax.random.key(15), (2, n, n, 16))
+    mesh = make_grid_mesh(2, 2, 2)
+    with use_mesh(mesh):
+        with pytest.raises(ValueError, match="block-aligned"):
+            mod.init(jax.random.key(16), x)
+
+
 def test_indivisible_axis_raises():
     # N/spr = 4 rows per device, spc = 2 -> fine; but N=6 local rows 3 is
     # not divisible by spc=2 for the transpose
