@@ -52,6 +52,20 @@ INGRAPH = _env_int("AF2TPU_BENCH_INGRAPH", 4)
 DEADLINE = _env_int("AF2TPU_BENCH_DEADLINE", 1500)
 
 
+# ATTEMPTS/DEADLINE tune retry/timeout infra, not the measured config
+_INFRA_KNOBS = {"AF2TPU_BENCH_ATTEMPTS", "AF2TPU_BENCH_DEADLINE"}
+
+
+def config_overridden() -> bool:
+    """True when AF2TPU_BENCH_* env overrides change the measured config —
+    such runs must be neither compared against nor recorded as the
+    flagship baseline."""
+    return any(
+        k.startswith("AF2TPU_BENCH_") and k not in _INFRA_KNOBS
+        for k in os.environ
+    )
+
+
 def _metric() -> str:
     """One label for success and failure records — the driver correlates
     records for the same config by this string."""
@@ -124,11 +138,7 @@ def main():
     mfu = _estimate_mfu(compiled, dt * INGRAPH)
 
     baseline_path = os.path.join(os.path.dirname(__file__), "bench_baseline.json")
-    # ATTEMPTS/DEADLINE tune retry/timeout infra, not the measured config
-    _infra = {"AF2TPU_BENCH_ATTEMPTS", "AF2TPU_BENCH_DEADLINE"}
-    overridden = any(
-        k.startswith("AF2TPU_BENCH_") and k not in _infra for k in os.environ
-    )
+    overridden = config_overridden()
     vs_baseline = 1.0
     compared = False
     if os.path.exists(baseline_path) and not overridden:
@@ -166,6 +176,7 @@ def main():
     if mfu is not None:
         record["mfu"] = round(mfu, 4)
     _emit(record)
+    return record
 
 
 # published peak dense bf16 FLOPs/s per chip (v5e's oft-quoted 394 is int8)
