@@ -66,14 +66,32 @@ def main():
 
     total = 0.0
     total += timed(
-        "pair AxialAttention (flash)",
+        "pair AxialAttention (grid-native, flash)",
         AxialAttention(dim=DIM, heads=8, dim_head=64, dtype=dt), pair,
     )
-    total += timed(
-        "pair AxialAttention (no flash)",
-        AxialAttention(dim=DIM, heads=8, dim_head=64, use_flash=False, dtype=dt),
-        pair,
-    )
+    # the A/B for the grid-native default: the flat route materializes a
+    # transpose of the whole pair map for the column pass. 3 extra compiles
+    # of the hottest module — AF2TPU_BENCH_AB=0 skips once the question is
+    # settled on real hardware.
+    if os.environ.get("AF2TPU_BENCH_AB", "1") == "1":
+        timed(
+            "pair AxialAttention (flat route, flash)",
+            AxialAttention(dim=DIM, heads=8, dim_head=64, grid_native=False,
+                           dtype=dt),
+            pair,
+        )
+        timed(
+            "pair AxialAttention (grid-native, no flash)",
+            AxialAttention(dim=DIM, heads=8, dim_head=64, use_flash=False,
+                           dtype=dt),
+            pair,
+        )
+        timed(
+            "pair AxialAttention (flat route, no flash)",
+            AxialAttention(dim=DIM, heads=8, dim_head=64, use_flash=False,
+                           grid_native=False, dtype=dt),
+            pair,
+        )
     total += timed(
         "msa AxialAttention tied",
         AxialAttention(dim=DIM, heads=8, dim_head=64, tie_row_attn=True, dtype=dt),
