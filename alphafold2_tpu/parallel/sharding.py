@@ -92,11 +92,18 @@ def msa_spec(rows: bool = False) -> P:
     """MSA grid (B, M, Nm, D) layout: replicated over sp by default (M is
     tiny next to N^2); ``rows=True`` shards the row axis over sp — the
     tied-row logit contraction then completes with an XLA-inserted psum
-    (SURVEY.md S7: "tied-rows becomes a collective"), scaling MSA depth."""
+    (SURVEY.md S7: "tied-rows becomes a collective"), scaling MSA depth.
+    On a 2D grid mesh (no sp axis) the row axis shards over spr instead,
+    so tied-row psum composes with the pair-grid layout."""
     if rows:
         mesh = _active["mesh"]
         if mesh is not None and SEQ_AXIS in mesh.axis_names:
             return P(DATA_AXIS, SEQ_AXIS)
+        if mesh is not None:
+            from alphafold2_tpu.parallel.grid_parallel import ROW_AXIS_NAME
+
+            if ROW_AXIS_NAME in mesh.axis_names:
+                return P(DATA_AXIS, ROW_AXIS_NAME)
     return P(DATA_AXIS)
 
 

@@ -129,3 +129,36 @@ def test_msa_row_shard_tied_step_matches_single_device():
     assert np.isclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-4)
     for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_msa_row_shard_composes_with_grid_mesh():
+    """msa_row_shard on a (dp, spr, spc) grid mesh: MSA rows shard over spr
+    (no sp axis exists), so the tied-row psum composes with 2D pair-grid
+    sharding instead of silently replicating. Numbers == single device."""
+    from alphafold2_tpu.parallel.grid_parallel import make_grid_mesh
+
+    cfg = Config(
+        model=ModelConfig(dim=32, depth=1, heads=2, dim_head=16,
+                          max_seq_len=64, bfloat16=False,
+                          msa_tie_row_attn=True, msa_row_shard=True,
+                          grid_parallel=True),
+        mesh=MeshConfig(data_parallel=2, grid_rows=2, grid_cols=2),
+        data=DataConfig(crop_len=16, msa_depth=4, msa_len=16, batch_size=2,
+                        min_len_filter=16),
+        train=TrainConfig(gradient_accumulate_every=1, warmup_steps=2),
+    )
+    batch = next(iter(SyntheticDataset(cfg.data, seed=6)))
+    model = build_model(cfg)
+
+    state1 = init_state(cfg, model, batch)
+    step1 = make_train_step(model, mesh=None)
+    s1, m1 = step1(state1, device_put_batch(batch), jax.random.key(17))
+
+    mesh = make_grid_mesh(2, 2, 2)  # 4 MSA rows over spr=2
+    state2 = init_state(cfg, model, batch)
+    step2 = make_train_step(model, mesh=mesh)
+    s2, m2 = step2(state2, device_put_batch(batch, mesh), jax.random.key(17))
+
+    assert np.isclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
