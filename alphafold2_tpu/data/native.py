@@ -63,6 +63,13 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_float, ctypes.c_float, ctypes.c_int32,
     ]
     lib.af2_loader_create.restype = ctypes.c_void_p
+    lib.af2_real_loader_create.argtypes = [
+        ctypes.c_int, i32p, i32p, f32p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_double, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_float, ctypes.c_float, ctypes.c_int32,
+    ]
+    lib.af2_real_loader_create.restype = ctypes.c_void_p
     lib.af2_loader_next.argtypes = [
         ctypes.c_void_p, i32p, i32p, u8p, u8p, f32p, f32p, i32p,
     ]
@@ -154,6 +161,14 @@ class NativeSyntheticLoader:
     ``close()``.
     """
 
+    def _bind(self, config: DataConfig) -> ctypes.CDLL:
+        """Shared init prelude: load the library and stash lib/config."""
+        lib = _load()
+        assert lib is not None, "native library not built (make -C native)"
+        self._lib = lib
+        self.config = config
+        return lib
+
     def __init__(
         self,
         config: DataConfig,
@@ -162,10 +177,7 @@ class NativeSyntheticLoader:
         queue_capacity: int = 4,
         ignore_index: int = -100,
     ):
-        lib = _load()
-        assert lib is not None, "native library not built (make -C native)"
-        self._lib = lib
-        self.config = config
+        lib = self._bind(config)
         self._handle = lib.af2_loader_create(
             config.batch_size, config.crop_len, config.msa_depth,
             config.msa_len, config.min_len_filter, seed, num_workers,
@@ -217,3 +229,56 @@ class NativeSyntheticLoader:
             self.close()
         except Exception:
             pass
+
+
+class NativeShardLoader(NativeSyntheticLoader):
+    """Real-data twin of :class:`NativeSyntheticLoader`: npz shard chains
+    are loaded once on the Python side (np.load at startup), registered with
+    (copied into) the C++ loader, and worker threads then do the per-step
+    crop/pad/MSA-synthesis/label work in the prefetch ring — the real-data
+    equivalent of torch DataLoader workers the reference leans on
+    (train_pre.py:37-48). Chain choice is uniform per sample (seeded), so
+    the stream is deterministic in (seed, batch index) for any worker count
+    — unlike :class:`~alphafold2_tpu.data.pipeline.NpzShardDataset`'s
+    epoch-shuffle order.
+    """
+
+    def __init__(
+        self,
+        config: DataConfig,
+        seed: int = 0,
+        num_workers: int = 2,
+        queue_capacity: int = 4,
+        ignore_index: int = -100,
+        mutation_rate: float = 0.15,
+        chains: Optional[list] = None,  # precomputed load_npz_chains output
+    ):
+        from alphafold2_tpu.data.pipeline import (
+            MSA_FALLBACK_WARNING,
+            load_npz_chains,
+        )
+
+        lib = self._bind(config)
+        if chains is None:
+            chains, any_msa = load_npz_chains(config)
+            if any_msa:
+                import warnings
+
+                warnings.warn(MSA_FALLBACK_WARNING)
+        lens = np.asarray([len(s) for s, _ in chains], np.int32)
+        seq_cat = np.ascontiguousarray(
+            np.concatenate([s for s, _ in chains]), np.int32
+        )
+        bb_cat = np.ascontiguousarray(
+            np.concatenate([b.reshape(-1) for _, b in chains]), np.float32
+        )
+        self.num_chains = len(chains)
+        self._handle = lib.af2_real_loader_create(
+            len(chains), _ptr(lens, ctypes.c_int32),
+            _ptr(seq_cat, ctypes.c_int32), _ptr(bb_cat, ctypes.c_float),
+            config.batch_size, config.crop_len, config.msa_depth,
+            config.msa_len, mutation_rate, seed, num_workers, queue_capacity,
+            constants.DISTOGRAM_BUCKETS, constants.DISTOGRAM_MIN_DIST,
+            constants.DISTOGRAM_MAX_DIST, ignore_index,
+        )
+        assert self._handle, "af2_real_loader_create failed"

@@ -172,6 +172,92 @@ class SidechainnetDataset:
                 yield out
 
 
+def _npz_paths(data_dir: str) -> list:
+    import glob
+    import os
+
+    assert data_dir, "npz shards need data.data_dir"
+    paths = sorted(glob.glob(os.path.join(data_dir, "*.npz")))
+    if not paths:
+        raise FileNotFoundError(f"no .npz shards under {data_dir!r}")
+    return paths
+
+
+def _read_shard(path: str):
+    """One shard -> (seq (L,) int32, coords float32, msa (M, L) int32 or
+    None), shape-validated so malformed shards fail loudly here rather than
+    corrupting downstream consumers (the native loader trusts lengths)."""
+    with np.load(path) as z:
+        seq = np.ascontiguousarray(z["seq"], np.int32)
+        coords = np.asarray(z["coords"], np.float32)
+        msa = np.asarray(z["msa"], np.int32) if "msa" in z else None
+    n = len(seq)
+    ok = (coords.ndim == 2 and coords.shape == (n, 3)) or (
+        coords.ndim == 3
+        and coords.shape[0] == n
+        and coords.shape[1] >= 3
+        and coords.shape[2] == 3
+    )
+    if not ok:
+        raise ValueError(
+            f"shard {path!r}: coords shape {coords.shape} does not match "
+            f"seq length {n} (want (L, 3) CA or (L, k>=3, 3) atomic)"
+        )
+    if msa is not None and (msa.ndim != 2 or msa.shape[1] != n):
+        raise ValueError(
+            f"shard {path!r}: msa shape "
+            f"{msa.shape} does not match seq length {n} (want (M, L))"
+        )
+    return seq, coords, msa
+
+
+def _length_ok(n: int, config: DataConfig) -> bool:
+    return max(4, config.min_len_filter) <= n <= config.max_len_filter
+
+
+def _shard_backbone(coords: np.ndarray, rng) -> tuple:
+    """coords -> (ca (L, 3), backbone_atoms (L*3, 3)); CA-only shards get
+    synthesized N/C pseudo-atoms so structure losses have a real target."""
+    if coords.ndim == 3:  # (L, k, 3) atomic: slots 0..2 = N/CA/C
+        return coords[:, 1], coords[:, :3].reshape(-1, 3)
+    return coords, _synthesize_backbone(rng, coords)
+
+
+# one message for the one policy, whichever entry point detects it
+MSA_FALLBACK_WARNING = (
+    "shards carry stored MSAs, which the native loader would replace with "
+    "mutation-synthesized ones; use the numpy npz pipeline "
+    "(data.source='npz') to train on the stored alignments"
+)
+
+
+def load_npz_chains(config: DataConfig) -> tuple:
+    """Load every length-filtered chain from the ``.npz`` shard directory as
+    ``(seq (L,) int32, backbone (L, 3, 3) float32)`` pairs — the registry
+    format the native real-data loader copies once at startup. Returns
+    ``(chains, any_msa)``; ``any_msa`` is True when any length-passing
+    shard carries a stored MSA (which this registry format cannot hold)."""
+    rng = np.random.default_rng(0)
+    chains = []
+    any_msa = False
+    for p in _npz_paths(config.data_dir):
+        seq, coords, msa = _read_shard(p)
+        if not _length_ok(len(seq), config):
+            continue
+        any_msa = any_msa or msa is not None
+        _, backbone_atoms = _shard_backbone(coords, rng)
+        chains.append((
+            seq,
+            np.ascontiguousarray(backbone_atoms.reshape(len(seq), 3, 3)),
+        ))
+    if not chains:
+        raise ValueError(
+            f"no shard in {config.data_dir!r} passes the length filter "
+            f"[{config.min_len_filter}, {config.max_len_filter}]"
+        )
+    return chains, any_msa
+
+
 class NpzShardDataset:
     """Local real-data ingestion: a directory of ``.npz`` shards.
 
@@ -185,17 +271,9 @@ class NpzShardDataset:
     """
 
     def __init__(self, config: DataConfig, seed: int = 0):
-        import glob
-        import os
-
-        assert config.data_dir, "source='npz' needs data.data_dir"
         self.config = config
         self.seed = seed
-        self.paths = sorted(glob.glob(os.path.join(config.data_dir, "*.npz")))
-        if not self.paths:
-            raise FileNotFoundError(
-                f"no .npz shards under {config.data_dir!r}"
-            )
+        self.paths = _npz_paths(config.data_dir)
 
     def __iter__(self) -> Iterator[dict]:
         cfg = self.config
@@ -207,23 +285,12 @@ class NpzShardDataset:
             rng.shuffle(order)
             accepted = 0
             for idx in order:
-                with np.load(self.paths[idx]) as z:
-                    seq = np.asarray(z["seq"], np.int32)
-                    coords = np.asarray(z["coords"], np.float32)
-                    msa_full = (
-                        np.asarray(z["msa"], np.int32) if "msa" in z else None
-                    )
+                seq, coords, msa_full = _read_shard(self.paths[idx])
                 n = len(seq)
-                if n < max(4, cfg.min_len_filter) or n > cfg.max_len_filter:
+                if not _length_ok(n, cfg):
                     continue
                 accepted += 1
-                if coords.ndim == 3:  # (L, k, 3) atomic: slots 0..2 = N/CA/C
-                    backbone_atoms = coords[:, :3].reshape(-1, 3)
-                    ca = coords[:, 1]
-                else:  # CA-only shard: synthesize N/C pseudo-atoms so the
-                    # end2end structure loss has a real (nonzero) target
-                    ca = coords
-                    backbone_atoms = _synthesize_backbone(rng, ca)
+                ca, backbone_atoms = _shard_backbone(coords, rng)
                 start = 0 if n <= L else int(rng.integers(0, n - L + 1))
                 end = min(start + L, n)
                 w = end - start
@@ -271,6 +338,18 @@ def make_dataset(config: DataConfig, seed: int = 0):
         from alphafold2_tpu.data import native
 
         if native.available():
+            # data_dir set -> real npz shards through the native prefetch
+            # ring; otherwise the native synthetic stream
+            if config.data_dir:
+                chains, any_msa = load_npz_chains(config)
+                if any_msa:
+                    import warnings
+
+                    warnings.warn(MSA_FALLBACK_WARNING)
+                    return NpzShardDataset(config, seed=seed)
+                return native.NativeShardLoader(
+                    config, seed=seed, chains=chains
+                )
             return native.NativeSyntheticLoader(config, seed=seed)
         import warnings
 
@@ -278,6 +357,8 @@ def make_dataset(config: DataConfig, seed: int = 0):
             "native loader requested but libaf2data.so is not built "
             "(make -C native); falling back to the numpy pipeline"
         )
+        if config.data_dir:
+            return NpzShardDataset(config, seed=seed)
         return SyntheticDataset(config, seed=seed)
     if config.source == "npz":
         return NpzShardDataset(config, seed=seed)

@@ -244,6 +244,59 @@ struct OwnedBatch {
   std::vector<int32_t> labels;  // (B, L, L) distogram labels
 };
 
+// Loader-owned copy of one real chain (seq tokens + N/CA/C backbone).
+struct Chain {
+  std::vector<int32_t> seq;
+  std::vector<float> backbone;  // (len, 3, 3) row-major
+};
+
+// Crop/pad/assemble one batch from registered real chains — the native twin
+// of data/pipeline.py:NpzShardDataset's per-item logic (random crop window,
+// prefix masks, MSA synthesized by mutating the cropped sequence). Chain
+// choice is uniform per sample (seeded), not epoch-shuffled: the stream is
+// deterministic in (seed, index) for any worker count.
+void fill_from_chains(const std::vector<Chain>& chains, const BatchSpec& spec,
+                      double mutation_rate, uint64_t seed, BatchBuffers buf) {
+  const int B = spec.batch, L = spec.crop_len, M = spec.msa_depth,
+            NM = spec.msa_len;
+  Rng rng(seed);
+  std::memset(buf.mask, 0, (size_t)B * L);
+  std::memset(buf.msa_mask, 0, (size_t)B * M * NM);
+  std::memset(buf.coords, 0, (size_t)B * L * 3 * sizeof(float));
+  std::memset(buf.backbone, 0, (size_t)B * L * 9 * sizeof(float));
+  for (int b = 0; b < B; ++b) {
+    const Chain& c = chains[rng.below(chains.size())];
+    const int len = (int)c.seq.size();
+    const int start = len > L ? (int)rng.below((uint64_t)(len - L + 1)) : 0;
+    const int w = len < L ? len : L;
+    int32_t* seq_row = buf.seq + (size_t)b * L;
+    for (int i = 0; i < L; ++i)
+      seq_row[i] = i < w ? c.seq[(size_t)start + i] : kPadIndex;
+    for (int i = 0; i < w; ++i) buf.mask[(size_t)b * L + i] = 1;
+    float* crow = buf.coords + (size_t)b * L * 3;
+    float* bb = buf.backbone + (size_t)b * L * 9;
+    for (int i = 0; i < w; ++i) {
+      const float* res = c.backbone.data() + (size_t)(start + i) * 9;
+      std::memcpy(bb + (size_t)i * 9, res, 9 * sizeof(float));
+      std::memcpy(crow + (size_t)i * 3, res + 3, 3 * sizeof(float));  // CA
+    }
+    const int msa_len = w < NM ? w : NM;
+    for (int m = 0; m < M; ++m) {
+      int32_t* mrow = buf.msa + ((size_t)b * M + m) * NM;
+      uint8_t* mm = buf.msa_mask + ((size_t)b * M + m) * NM;
+      for (int i = 0; i < NM; ++i) {
+        if (i < msa_len) {
+          mrow[i] = rng.uniform() < mutation_rate ? (int32_t)rng.below(20)
+                                                  : seq_row[i];
+          mm[i] = 1;
+        } else {
+          mrow[i] = kPadIndex;
+        }
+      }
+    }
+  }
+}
+
 struct BatchOrder {
   bool operator()(const OwnedBatch* a, const OwnedBatch* b) const {
     return a->index > b->index;  // min-heap on index
@@ -256,6 +309,8 @@ struct Loader {
   int num_buckets;
   float min_dist, max_dist;
   int32_t ignore_index;
+  std::vector<Chain> chains;     // non-empty => real-data mode
+  double mutation_rate = 0.15;   // MSA synthesis rate (real-data mode)
 
   std::vector<std::thread> workers;
   // Min-heap keyed by batch index + a consume cursor: workers claim indices
@@ -286,7 +341,11 @@ struct Loader {
       BatchBuffers buf{ob->seq.data(), ob->msa.data(), ob->mask.data(),
                        ob->msa_mask.data(), ob->coords.data(),
                        ob->backbone.data()};
-      synthesize_into(spec, base_seed + ob->index, buf);
+      if (chains.empty())
+        synthesize_into(spec, base_seed + ob->index, buf);
+      else
+        fill_from_chains(chains, spec, mutation_rate, base_seed + ob->index,
+                         buf);
       for (int b = 0; b < B; ++b)
         af2_bucketize_distances(ob->coords.data() + (size_t)b * L * 3,
                                 ob->mask.data() + (size_t)b * L, L,
@@ -312,12 +371,13 @@ struct Loader {
 
 }  // namespace
 
-void* af2_loader_create(int batch, int crop_len, int msa_depth, int msa_len,
-                        int min_len, uint64_t seed, int num_workers,
-                        int queue_capacity, int num_buckets, float min_dist,
-                        float max_dist, int32_t ignore_index) {
-  auto* ld = new Loader();
-  ld->spec = BatchSpec{batch, crop_len, msa_depth, msa_len, min_len};
+namespace {
+
+// Shared init tail: label-bucketization params, queue window, worker spawn.
+// ld->spec (and chains/mutation_rate for real-data mode) must be set first.
+void* loader_start(Loader* ld, uint64_t seed, int num_workers,
+                   int queue_capacity, int num_buckets, float min_dist,
+                   float max_dist, int32_t ignore_index) {
   ld->base_seed = seed;
   ld->num_buckets = num_buckets;
   ld->min_dist = min_dist;
@@ -328,6 +388,53 @@ void* af2_loader_create(int batch, int crop_len, int msa_depth, int msa_len,
   for (int i = 0; i < num_workers; ++i)
     ld->workers.emplace_back([ld] { ld->worker_loop(); });
   return ld;
+}
+
+}  // namespace
+
+void* af2_loader_create(int batch, int crop_len, int msa_depth, int msa_len,
+                        int min_len, uint64_t seed, int num_workers,
+                        int queue_capacity, int num_buckets, float min_dist,
+                        float max_dist, int32_t ignore_index) {
+  auto* ld = new Loader();
+  ld->spec = BatchSpec{batch, crop_len, msa_depth, msa_len, min_len};
+  return loader_start(ld, seed, num_workers, queue_capacity, num_buckets,
+                      min_dist, max_dist, ignore_index);
+}
+
+// Real-data prefetching loader: same worker/ring machinery, but batches are
+// cropped/padded from registered chains instead of synthesized. Chains are
+// passed concatenated (seq_cat: sum(lens) int32 tokens; backbone_cat:
+// sum(lens)*9 floats, (len, 3, 3) N/CA/C per chain) and COPIED — the caller
+// may free its buffers after this returns. Returns NULL when n_chains < 1
+// or any length < 1.
+void* af2_real_loader_create(int n_chains, const int32_t* lens,
+                             const int32_t* seq_cat, const float* backbone_cat,
+                             int batch, int crop_len, int msa_depth,
+                             int msa_len, double mutation_rate, uint64_t seed,
+                             int num_workers, int queue_capacity,
+                             int num_buckets, float min_dist, float max_dist,
+                             int32_t ignore_index) {
+  if (n_chains < 1) return nullptr;
+  auto* ld = new Loader();
+  size_t off = 0;
+  for (int c = 0; c < n_chains; ++c) {
+    const int len = lens[c];
+    if (len < 1) {
+      delete ld;
+      return nullptr;
+    }
+    Chain ch;
+    ch.seq.assign(seq_cat + off, seq_cat + off + len);
+    ch.backbone.assign(backbone_cat + off * 9,
+                       backbone_cat + (off + len) * 9);
+    ld->chains.push_back(std::move(ch));
+    off += (size_t)len;
+  }
+  ld->spec = BatchSpec{batch, crop_len, msa_depth, msa_len, /*min_len=*/1};
+  ld->mutation_rate = mutation_rate;
+  return loader_start(ld, seed, num_workers, queue_capacity, num_buckets,
+                      min_dist, max_dist, ignore_index);
 }
 
 // Blocks until a batch is ready, then copies it into the caller's buffers.
