@@ -260,7 +260,7 @@ class NativeShardLoader(NativeSyntheticLoader):
 
         lib = self._bind(config)
         if chains is None:
-            chains, any_msa = load_npz_chains(config)
+            chains, any_msa = load_npz_chains(config, seed=seed)
             if any_msa:
                 import warnings
 

@@ -231,13 +231,28 @@ MSA_FALLBACK_WARNING = (
 )
 
 
-def load_npz_chains(config: DataConfig) -> tuple:
+def shards_carry_msa(config: DataConfig) -> bool:
+    """Cheap pre-scan: does any length-passing shard store an MSA? Reads
+    only zip directories and the small ``seq`` arrays — no coords — so
+    routing decisions don't pay a full dataset load."""
+    for p in _npz_paths(config.data_dir):
+        with np.load(p) as z:
+            if "msa" in z.files and _length_ok(len(z["seq"]), config):
+                return True
+    return False
+
+
+def load_npz_chains(config: DataConfig, seed: int = 0) -> tuple:
     """Load every length-filtered chain from the ``.npz`` shard directory as
     ``(seq (L,) int32, backbone (L, 3, 3) float32)`` pairs — the registry
     format the native real-data loader copies once at startup. Returns
     ``(chains, any_msa)``; ``any_msa`` is True when any length-passing
-    shard carries a stored MSA (which this registry format cannot hold)."""
-    rng = np.random.default_rng(0)
+    shard carries a stored MSA (which this registry format cannot hold).
+
+    ``seed`` drives the N/C pseudo-atom jitter for CA-only shards. The
+    registry is built once, so that jitter is fixed for the run (the numpy
+    pipeline re-draws per epoch) but varies across training seeds."""
+    rng = np.random.default_rng(seed)
     chains = []
     any_msa = False
     for p in _npz_paths(config.data_dir):
@@ -341,15 +356,12 @@ def make_dataset(config: DataConfig, seed: int = 0):
             # data_dir set -> real npz shards through the native prefetch
             # ring; otherwise the native synthetic stream
             if config.data_dir:
-                chains, any_msa = load_npz_chains(config)
-                if any_msa:
+                if shards_carry_msa(config):
                     import warnings
 
                     warnings.warn(MSA_FALLBACK_WARNING)
                     return NpzShardDataset(config, seed=seed)
-                return native.NativeShardLoader(
-                    config, seed=seed, chains=chains
-                )
+                return native.NativeShardLoader(config, seed=seed)
             return native.NativeSyntheticLoader(config, seed=seed)
         import warnings
 

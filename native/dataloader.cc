@@ -98,6 +98,25 @@ struct Rng {
 
 constexpr int kPadIndex = 20;  // constants.AA_PAD_INDEX
 
+// Shared MSA synthesis: M rows mutated from the (cropped) primary sequence
+// at `rate`, masked to msa_len. Draw order (uniform, then conditional
+// below) is part of the deterministic stream contract for both loaders.
+void fill_msa_rows(Rng& rng, const int32_t* seq_row, int msa_len, double rate,
+                   int M, int NM, int32_t* msa, uint8_t* msa_mask) {
+  for (int m = 0; m < M; ++m) {
+    int32_t* mrow = msa + (size_t)m * NM;
+    uint8_t* mm = msa_mask + (size_t)m * NM;
+    for (int i = 0; i < NM; ++i) {
+      if (i < msa_len) {
+        mrow[i] = rng.uniform() < rate ? (int32_t)rng.below(20) : seq_row[i];
+        mm[i] = 1;
+      } else {
+        mrow[i] = kPadIndex;
+      }
+    }
+  }
+}
+
 void smooth_walk(Rng& rng, int n, float* out /* (n,3) */) {
   // compact CA trace: ~3.8A steps with direction persistence, centered
   // (normalize the fresh step BEFORE the 0.6/0.4 blend, matching the numpy
@@ -203,18 +222,9 @@ void synthesize_into(const BatchSpec& spec, uint64_t seed, BatchBuffers buf) {
 
     // MSA rows: mutate the primary sequence at rate 0.15
     const int msa_len = true_len < NM ? true_len : NM;
-    for (int m = 0; m < M; ++m) {
-      int32_t* mrow = buf.msa + ((size_t)b * M + m) * NM;
-      uint8_t* mm = buf.msa_mask + ((size_t)b * M + m) * NM;
-      for (int i = 0; i < NM; ++i) {
-        if (i < msa_len) {
-          mrow[i] = rng.uniform() < 0.15 ? (int32_t)rng.below(20) : seq_row[i];
-          mm[i] = 1;
-        } else {
-          mrow[i] = kPadIndex;
-        }
-      }
-    }
+    fill_msa_rows(rng, seq_row, msa_len, 0.15, M, NM,
+                  buf.msa + (size_t)b * M * NM,
+                  buf.msa_mask + (size_t)b * M * NM);
   }
 }
 
@@ -281,19 +291,9 @@ void fill_from_chains(const std::vector<Chain>& chains, const BatchSpec& spec,
       std::memcpy(crow + (size_t)i * 3, res + 3, 3 * sizeof(float));  // CA
     }
     const int msa_len = w < NM ? w : NM;
-    for (int m = 0; m < M; ++m) {
-      int32_t* mrow = buf.msa + ((size_t)b * M + m) * NM;
-      uint8_t* mm = buf.msa_mask + ((size_t)b * M + m) * NM;
-      for (int i = 0; i < NM; ++i) {
-        if (i < msa_len) {
-          mrow[i] = rng.uniform() < mutation_rate ? (int32_t)rng.below(20)
-                                                  : seq_row[i];
-          mm[i] = 1;
-        } else {
-          mrow[i] = kPadIndex;
-        }
-      }
-    }
+    fill_msa_rows(rng, seq_row, msa_len, mutation_rate, M, NM,
+                  buf.msa + (size_t)b * M * NM,
+                  buf.msa_mask + (size_t)b * M * NM);
   }
 }
 

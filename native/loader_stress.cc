@@ -19,6 +19,13 @@ void* af2_loader_create(int batch, int crop_len, int msa_depth, int msa_len,
                         int min_len, uint64_t seed, int num_workers,
                         int queue_capacity, int num_buckets, float min_dist,
                         float max_dist, int32_t ignore_index);
+void* af2_real_loader_create(int n_chains, const int32_t* lens,
+                             const int32_t* seq_cat, const float* backbone_cat,
+                             int batch, int crop_len, int msa_depth,
+                             int msa_len, double mutation_rate, uint64_t seed,
+                             int num_workers, int queue_capacity,
+                             int num_buckets, float min_dist, float max_dist,
+                             int32_t ignore_index);
 int af2_loader_next(void* handle, int32_t* seq, int32_t* msa, uint8_t* mask,
                     uint8_t* msa_mask, float* coords, float* backbone,
                     int32_t* labels);
@@ -55,6 +62,32 @@ int main(int argc, char** argv) {
     void* ld = af2_loader_create(B, L, M, NM, 8, r, 4, 2, 37, 2.0f, 20.0f,
                                  -100);
     af2_loader_destroy(ld);
+  }
+
+  // the real-data fill path under the same contention: two registered
+  // chains (one shorter, one longer than the crop), 8 producers, 1-slot
+  // window, mid-flight destruction
+  {
+    const int32_t lens[2] = {12, 24};
+    std::vector<int32_t> seq_cat(12 + 24);
+    std::vector<float> bb_cat((size_t)(12 + 24) * 9);
+    for (size_t i = 0; i < seq_cat.size(); ++i) seq_cat[i] = (int32_t)(i % 20);
+    for (size_t i = 0; i < bb_cat.size(); ++i) bb_cat[i] = 0.37f * (float)i;
+    for (int r = 0; r < rounds; ++r) {
+      void* ld = af2_real_loader_create(2, lens, seq_cat.data(), bb_cat.data(),
+                                        B, L, M, NM, 0.15, 99 + r, 8, 1, 37,
+                                        2.0f, 20.0f, -100);
+      if (!ld) return 1;
+      for (int i = 0; i < 64; ++i) {
+        if (af2_loader_next(ld, seq.data(), msa.data(), mask.data(),
+                            msa_mask.data(), coords.data(), backbone.data(),
+                            labels.data()) != 0) {
+          std::fprintf(stderr, "real round %d: stopped early at %d\n", r, i);
+          return 1;
+        }
+      }
+      af2_loader_destroy(ld);
+    }
   }
   std::puts("loader_stress ok");
   return 0;
