@@ -149,6 +149,35 @@ class Alphafold2(nn.Module):
     ) -> jnp.ndarray:
         b, n = seq.shape
         dt = self.dtype
+        # Loud trace-time guards: the positional tables are fixed-size, and
+        # out-of-range gathers clip silently — observed as NaN logits /
+        # aliased positions rather than an actionable error. Shapes are
+        # static under jit, so plain Python raises work here. Driver-level
+        # remediation hints live with the drivers (train/end2end.py,
+        # predict.py).
+        if n > self.max_seq_len:
+            raise ValueError(
+                f"sequence length {n} exceeds max_seq_len {self.max_seq_len}"
+            )
+        if msa is not None:
+            if msa.shape[-1] > self.max_seq_len:
+                raise ValueError(
+                    f"MSA length {msa.shape[-1]} exceeds max_seq_len "
+                    f"{self.max_seq_len}"
+                )
+            if msa.shape[1] > self.max_num_msas:
+                raise ValueError(
+                    f"MSA depth {msa.shape[1]} exceeds max_num_msas "
+                    f"{self.max_num_msas} (reference MAX_NUM_MSA)"
+                )
+        if templates_seq is not None and (
+            templates_seq.shape[1] > self.max_num_templates
+        ):
+            raise ValueError(
+                f"{templates_seq.shape[1]} templates exceed "
+                f"max_num_templates {self.max_num_templates} "
+                "(reference MAX_NUM_TEMPLATES)"
+            )
 
         token_emb = nn.Embed(self.num_tokens, self.dim, dtype=dt, name="token_emb")
         pos_emb = nn.Embed(self.max_seq_len, self.dim, dtype=dt, name="pos_emb")

@@ -233,6 +233,12 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
     from alphafold2_tpu.train.observe import MetricsLogger
 
     num_steps = num_steps or cfg.train.num_steps
+    if cfg.model.max_seq_len < 3 * cfg.data.crop_len:
+        raise ValueError(
+            f"end-to-end training elongates each residue x3 (N/CA/C): "
+            f"model.max_seq_len={cfg.model.max_seq_len} must be >= "
+            f"3*data.crop_len={3 * cfg.data.crop_len}"
+        )
     owns_dataset = dataset is None
     # per-host data seed: each process feeds its own global-batch slice
     data_seed = cfg.train.seed + 7919 * jax.process_index()

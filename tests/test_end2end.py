@@ -34,6 +34,25 @@ def tiny_model():
                         mds_iters=20, refiner_depth=1)
 
 
+def test_max_seq_len_violations_fail_loudly():
+    # out-of-range positional gathers clip silently and surface as NaN
+    # logits, so both the driver and the model must refuse up front
+    import pytest
+
+    from alphafold2_tpu.models import Alphafold2
+    from alphafold2_tpu.train.end2end import train_end2end
+
+    cfg = tiny_cfg()
+    cfg.data.crop_len = 48  # 3*48 > max_seq_len 64
+    with pytest.raises(ValueError, match="3\\*data.crop_len"):
+        train_end2end(cfg, num_steps=1)
+
+    model = Alphafold2(dim=32, depth=1, heads=2, dim_head=16, max_seq_len=16)
+    seq = jnp.zeros((1, 24), jnp.int32)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        model.init(jax.random.key(0), seq)
+
+
 def test_elongate():
     seq = jnp.asarray([[3, 7]])
     mask = jnp.asarray([[True, False]])
