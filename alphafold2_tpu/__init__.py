@@ -30,3 +30,27 @@ def setup_platform(default: str | None = None) -> None:
         import jax
 
         jax.config.update("jax_platforms", platform)
+    enable_compile_cache()
+
+
+def enable_compile_cache() -> None:
+    """Point XLA's persistent compilation cache at a stable directory.
+
+    The flagship step takes minutes to compile; through the TPU tunnel a
+    single compile can consume a whole driver budget (round 1 lost both
+    driver artifacts to exactly that). With the cache, any later process
+    compiling the same HLO (the round-end bench after a measurement
+    session, a session relaunched after a tunnel drop) reuses the
+    serialized executable in seconds. Best-effort: backends that cannot
+    serialize executables simply miss the cache. ``AF2TPU_COMPILE_CACHE=``
+    (empty) disables."""
+    cache_dir = _os.environ.get("AF2TPU_COMPILE_CACHE", "/tmp/af2tpu_xla_cache")
+    if not cache_dir:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
+    except Exception:  # unknown flags on old jax — the cache is optional
+        pass

@@ -18,6 +18,16 @@ and --no-rebaseline is not given, profile trace summary).
 Every stage is best-effort: a failure is recorded and the next stage runs.
 AF2TPU_SESSION_DEADLINE (seconds, default 10800) hard-bounds the whole
 session with a watchdog that flushes partial results before exiting.
+
+Tunnel-wedge recovery: the relay that proxies this process to the real TPU
+runs *inside* the process, and a dropped upstream leaves every later jax
+call hanging in C++ (observed: 50 min inside one remote_compile HTTP call).
+A hung stage cannot be interrupted from Python, so when a stage exceeds
+AF2TPU_STAGE_DEADLINE (seconds, default 2400) the watchdog records the
+timeout, flushes, and **re-execs this script with the remaining stages** —
+the fresh process brings up a fresh relay, and completed work is not lost:
+results merge into the existing TPU_SESSION.json, and recompiles hit the
+persistent compilation cache (alphafold2_tpu.enable_compile_cache).
 """
 
 from __future__ import annotations
@@ -40,9 +50,26 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT_PATH = os.path.join(REPO, "TPU_SESSION.json")
 _T0 = time.monotonic()
 DEADLINE = int(os.environ.get("AF2TPU_SESSION_DEADLINE", 10800))
+STAGE_DEADLINE = int(os.environ.get("AF2TPU_STAGE_DEADLINE", 2400))
 
 RESULTS: dict = {"stages": {}, "device": None}
+if os.environ.get("AF2TPU_SESSION_RESUME") and os.path.exists(OUT_PATH):
+    # merge ONLY across watchdog relaunches (marker env set right before
+    # os.execv): a re-exec'd session keeps completed stages' results and
+    # re-run stages overwrite their entry. A fresh session must NOT inherit
+    # a stale file from an earlier run (stage_baseline would re-record an
+    # old bench measurement as the current baseline).
+    try:
+        with open(OUT_PATH) as _f:
+            _prior = json.load(_f)
+        RESULTS["stages"].update(_prior.get("stages", {}))
+        RESULTS["device"] = _prior.get("device")
+    except Exception:
+        pass
 _FLUSH_LOCK = threading.Lock()
+# set by the stage loop for the stage watchdog: (name, start_monotonic,
+# remaining stage names after the current one)
+_CURRENT: dict = {"stage": None, "start": 0.0, "remaining": []}
 
 
 def _flush():
@@ -56,6 +83,7 @@ def _flush():
 def _stage(name, fn):
     print(f"=== stage: {name} ===", flush=True)
     t0 = time.monotonic()
+    _CURRENT["stage"], _CURRENT["start"] = name, t0
     try:
         out = fn()
         RESULTS["stages"][name] = {
@@ -69,6 +97,7 @@ def _stage(name, fn):
             "trace": traceback.format_exc()[-2000:],
         }
         print(f"stage {name} FAILED: {e}", flush=True)
+    _CURRENT["stage"] = None
     _flush()
 
 
@@ -230,19 +259,26 @@ def stage_bisect():
     return "printed to stdout"
 
 
+# cheap, high-value stages first: a tunnel that dies mid-session takes the
+# rest of the session's budget with it, so the big-compile stages (suite's
+# depth-12 configs, the capacity sweep) run last
 STAGES = {
     "bench": stage_bench,
     "baseline": stage_baseline,
-    "suite": stage_suite,
-    "capacity": stage_capacity,
     "pallas": stage_pallas,
     "profile": stage_profile,
     "bisect": stage_bisect,
+    "capacity": stage_capacity,
+    "suite": stage_suite,
 }
 
 
 def main():
     sys.path.insert(0, os.path.join(REPO, "scripts"))
+    # snapshot now: the _argv context manager swaps sys.argv while sub-script
+    # stages run, and the watchdog thread must not rebuild the relaunch
+    # command from that mutable global (it would drop e.g. --no-rebaseline)
+    flags = [a for a in sys.argv[1:] if a.startswith("-")]
 
     def _watchdog():
         time.sleep(max(0.0, DEADLINE - (time.monotonic() - _T0)))
@@ -253,11 +289,60 @@ def main():
     if DEADLINE > 0:
         threading.Thread(target=_watchdog, daemon=True).start()
 
+    def _stage_watchdog():
+        # a hung jax call (dead in-process relay) cannot be interrupted from
+        # Python; re-exec with the remaining stages — fresh process, fresh
+        # relay, prior results merged from TPU_SESSION.json, recompiles
+        # served by the persistent compilation cache
+        while True:
+            time.sleep(30)
+            name = _CURRENT["stage"]
+            if name is None:
+                continue
+            if time.monotonic() - _CURRENT["start"] <= STAGE_DEADLINE:
+                continue
+            RESULTS["stages"][name] = {
+                "ok": False,
+                "seconds": round(time.monotonic() - _CURRENT["start"], 1),
+                "error": f"stage deadline {STAGE_DEADLINE}s exceeded "
+                "(hung tunnel?); relaunching for remaining stages",
+            }
+            _flush()
+            remaining = _CURRENT["remaining"]
+            relaunches = int(os.environ.get("AF2TPU_SESSION_RELAUNCHES", 4))
+            elapsed = time.monotonic() - _T0
+            if (
+                not remaining
+                or relaunches <= 0
+                or (DEADLINE > 0 and elapsed > DEADLINE - STAGE_DEADLINE / 2)
+            ):
+                os._exit(0)
+            print(
+                f"stage {name} exceeded {STAGE_DEADLINE}s; re-exec for "
+                f"{remaining}", flush=True,
+            )
+            os.environ["AF2TPU_SESSION_RELAUNCHES"] = str(relaunches - 1)
+            os.environ["AF2TPU_SESSION_RESUME"] = "1"
+            if DEADLINE > 0:
+                # the child's fresh _T0 must not reset the session bound:
+                # hand it only the remaining budget
+                os.environ["AF2TPU_SESSION_DEADLINE"] = str(
+                    max(int(DEADLINE - elapsed), int(STAGE_DEADLINE / 2))
+                )
+            os.execv(
+                sys.executable,
+                [sys.executable, os.path.abspath(__file__)] + remaining + flags,
+            )
+
+    if STAGE_DEADLINE > 0:
+        threading.Thread(target=_stage_watchdog, daemon=True).start()
+
     requested = [a for a in sys.argv[1:] if not a.startswith("-")]
     names = requested or list(STAGES)
     unknown = [n for n in names if n not in STAGES]
     assert not unknown, f"unknown stages {unknown}; have {list(STAGES)}"
-    for name in names:
+    for i, name in enumerate(names):
+        _CURRENT["remaining"] = names[i + 1:]
         _stage(name, STAGES[name])
     print(json.dumps({
         n: {k: v for k, v in s.items() if k != "trace"}
