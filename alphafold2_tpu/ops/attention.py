@@ -208,14 +208,13 @@ class Attention(nn.Module):
         q, k, v = split_heads(q), split_heads(k), split_heads(v)  # (B, n, h, dh)
         scale = dh**-0.5
 
-        # Plain-softmax gate shared by the fused-kernel paths below: tied
-        # rows and compressed KV keep their bespoke dense computations, and
-        # attention-weight dropout needs materialized probabilities.
-        plain_softmax = (
-            tie_dim is None
-            and self.compress_ratio == 1
-            and (self.dropout == 0.0 or deterministic)
-        )
+        # Fused-kernel gate for the paths below: tied rows keep their
+        # bespoke dense contraction, and attention-weight dropout needs
+        # materialized probabilities. KV compression composes with the
+        # fused kernels — by this point k/v/context_mask are already the
+        # compressed versions, and at large crops the fused path is what
+        # keeps the (N^2 queries x compressed keys) logits out of HBM.
+        fused_ok = tie_dim is None and (self.dropout == 0.0 or deterministic)
         kv_mask = context_mask
         if kv_mask is None and not has_context:
             kv_mask = mask
@@ -230,7 +229,13 @@ class Attention(nn.Module):
         # context-parallel path: exact attention with the sequence axis
         # sharded over the mesh's sp axis (ring ppermute or Ulysses
         # all-to-all — parallel/seq_parallel.py), when a mesh is active.
-        if self.context_parallel is not None and plain_softmax:
+        # (compression is excluded here: the compressed KV length no longer
+        # matches the sequence-parallel shard layout)
+        if (
+            self.context_parallel is not None
+            and fused_ok
+            and self.compress_ratio == 1
+        ):
             from alphafold2_tpu.parallel.seq_parallel import (
                 SEQ_AXIS_NAME,
                 sequence_parallel_attention,
@@ -251,7 +256,7 @@ class Attention(nn.Module):
 
         # fused flash-attention path (TPU): the (n, n) attention matrix stays
         # in VMEM instead of HBM.
-        if self._use_flash() and plain_softmax:
+        if self._use_flash() and fused_ok:
             from alphafold2_tpu.ops.flash import flash_attention
 
             out = flash_attention(

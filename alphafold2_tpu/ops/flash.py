@@ -9,9 +9,10 @@ matrix never hits HBM. Padding masks are expressed as segment ids (valid=1,
 pad=0: cross-segment pairs are masked inside the kernel).
 
 Used automatically by :class:`ops.attention.Attention` on TPU backends for
-the un-tied, un-compressed paths; everything falls back to the jnp dense
-path off-TPU or if the kernel rejects the shape (trace-time validation is
-caught and logged once).
+the un-tied paths, including KV-compressed cross-attention (the kernel
+sees the already-compressed k/v and the pooled mask); everything falls
+back to the jnp dense path off-TPU or if the kernel rejects the shape
+(trace-time validation is caught and logged once).
 """
 
 from __future__ import annotations

@@ -33,6 +33,7 @@ persistent compilation cache (alphafold2_tpu.enable_compile_cache).
 
 from __future__ import annotations
 
+import hashlib
 import importlib
 import json
 import os
@@ -329,8 +330,17 @@ def stage_train_real():
         train=TrainConfig(
             num_steps=steps, gradient_accumulate_every=1, warmup_steps=100,
             log_every=100, checkpoint_every=500,
-            checkpoint_dir=os.environ.get(
-                "AF2TPU_TRAIN_REAL_CKPT", "/tmp/af2tpu_train_real_ckpt"
+            # key the resume checkpoint on the split + model shape: a stale
+            # checkpoint from a different split would otherwise restore at
+            # start_step=num_steps and report "holdout" metrics for chains
+            # the restored weights actually trained on
+            checkpoint_dir=os.path.join(
+                os.environ.get(
+                    "AF2TPU_TRAIN_REAL_CKPT", "/tmp/af2tpu_train_real_ckpt"
+                ),
+                hashlib.sha1(
+                    json.dumps([crop, train_shards]).encode()
+                ).hexdigest()[:10],
             ),
         ),
     )
@@ -371,9 +381,14 @@ def stage_train_real():
         "holdout_shards": holdout,
     }
     if holdout:
-        hce, hdl = eval_stream(holdout_dir)
-        out["holdout_eval_ce"] = hce  # chains never seen in training
-        out["holdout_distogram_lddt"] = hdl
+        # best-effort: e.g. every holdout chain outside the length filter
+        # raises here, and that must not discard the training metrics above
+        try:
+            hce, hdl = eval_stream(holdout_dir)
+            out["holdout_eval_ce"] = hce  # chains never seen in training
+            out["holdout_distogram_lddt"] = hdl
+        except Exception as e:
+            out["holdout_error"] = f"{type(e).__name__}: {e}"
     return out
 
 
