@@ -53,14 +53,24 @@ def flash_attention(
     b, h, nq, d = q.shape
     nk = k.shape[2]
 
-    # short sequences (either axis < one 128 block) use the dense path by
-    # design: the stock backward kernels hard-require kv blocks of >= 128
-    # (MIN_BLOCK_SIZE tiling), so sub-block shapes cannot run fused training
-    # — and at these sizes the dense attention matrix is trivially small
-    if nq < 128 or nk < 128:
+    # short sequences (BOTH axes < one 128 block) use the dense path by
+    # design: at these sizes the dense attention matrix is trivially small
+    # and the kernel's MIN_BLOCK_SIZE tiling overhead dominates. With one
+    # long axis (e.g. N^2 queries against a compressed context) the fused
+    # path still pays off — the short axis is padded up to a block below.
+    if nq < 128 and nk < 128:
         return None
+
+    # the kernel's block verification requires both sequence axes divisible
+    # by the 128-lane block (e.g. compressed-KV cross-attention lengths
+    # rarely are): pad with mask-excluded positions and slice the output
+    pad_q = (-nq) % 128
+    pad_k = (-nk) % 128
+    need_segments = (
+        q_mask is not None or kv_mask is not None or pad_q or pad_k
+    )
     segment_ids = None
-    if q_mask is not None or kv_mask is not None:
+    if need_segments:
         qs = (
             q_mask.astype(jnp.int32)
             if q_mask is not None
@@ -71,9 +81,18 @@ def flash_attention(
             if kv_mask is not None
             else jnp.ones((b, nk), jnp.int32)
         )
+        if pad_q:
+            qs = jnp.pad(qs, ((0, 0), (0, pad_q)))
+        if pad_k:
+            ks = jnp.pad(ks, ((0, 0), (0, pad_k)))
         segment_ids = SegmentIds(q=qs, kv=ks)
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
     try:
-        return _fa(q, k, v, segment_ids=segment_ids, sm_scale=sm_scale)
+        out = _fa(q, k, v, segment_ids=segment_ids, sm_scale=sm_scale)
     except (ValueError, NotImplementedError) as e:
         key = str(e)[:80]
         if key not in _WARNED:
@@ -83,3 +102,4 @@ def flash_attention(
                 f"k={k.shape}: {e}; using dense attention"
             )
         return None
+    return out[:, :, :nq] if pad_q else out

@@ -260,7 +260,11 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
         dtype=jnp.bfloat16 if cfg.model.bfloat16 else jnp.float32,
     )
     sample = next(data_iter)
-    state = init_end2end_state(cfg, model, sample)
+    # tiny-sliced init: identical params, none of the full-size init
+    # compile (train.loop.tiny_batch_like)
+    from alphafold2_tpu.train.loop import tiny_batch_like
+
+    state = init_end2end_state(cfg, model, tiny_batch_like(sample))
     step_fn = make_end2end_step(model, mesh)
 
     ckpt = None

@@ -81,8 +81,8 @@ def main():
     from alphafold2_tpu.train.loop import (
         build_model,
         device_put_batch,
-        init_state,
         make_train_step,
+        tiny_init_state,
     )
 
     cfg = Config(
@@ -99,20 +99,9 @@ def main():
 
     batch = next(iter(SyntheticDataset(cfg.data, seed=0)))
     model = build_model(cfg)
-    # Param shapes depend only on the model config (max_seq_len sizes the
-    # positional tables), not on crop/MSA batch shapes — so initialize at
-    # tiny shapes and skip compiling the full-size forward just for init
-    # (at crop 256 that second compile costs as much as the step itself).
-    tiny = Config(
-        model=cfg.model,
-        data=DataConfig(
-            crop_len=min(16, CROP), msa_depth=min(2, MSA_DEPTH),
-            msa_len=min(16, MSA_LEN), batch_size=BATCH,
-            min_len_filter=min(16, CROP),
-        ),
-        train=cfg.train,
-    )
-    state = init_state(tiny, model, next(iter(SyntheticDataset(tiny.data, seed=0))))
+    # init at tiny slices of the batch: identical params, none of the
+    # full-size init compile (train.loop.tiny_init_state)
+    state = tiny_init_state(cfg, model, batch)
     raw_step = make_train_step(model, mesh=None, jit=False)
     dev_batch = device_put_batch(batch)
     rng = jax.random.key(0)

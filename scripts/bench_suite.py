@@ -52,7 +52,7 @@ def _train_throughput(cfg_kw, data_kw, label):
     from alphafold2_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
     from alphafold2_tpu.data.pipeline import SyntheticDataset
     from alphafold2_tpu.train.loop import (
-        build_model, device_put_batch, init_state, make_train_step,
+        build_model, device_put_batch, make_train_step, tiny_init_state,
     )
 
     cfg = Config(
@@ -62,7 +62,7 @@ def _train_throughput(cfg_kw, data_kw, label):
     )
     batch = next(iter(SyntheticDataset(cfg.data, seed=0)))
     model = build_model(cfg)
-    state = init_state(cfg, model, batch)
+    state = tiny_init_state(cfg, model, batch)
     step = make_train_step(model, mesh=None)
     dev_batch = device_put_batch(batch)
     rng = jax.random.key(0)
@@ -163,7 +163,18 @@ def config_4():
         templates_seq=t_seq, templates_coors=t_coors,
         templates_mask=jnp.ones((1, T, crop), bool),
     )
-    params = model.init(k, seq, msa, **kw)
+    # init at tiny shapes (params depend only on the model config; the
+    # template tables are sized by max_num_templates/max_seq_len) — skips
+    # the full-size init compile, which at crop 384 + templates dominates
+    tn, tm, tT = min(16, crop), min(2, msa_d), min(2, T)
+    params = model.init(
+        k, seq[:, :tn], msa[:, :tm, :tn],
+        mask=kw["mask"][:, :tn],
+        msa_mask=kw["msa_mask"][:, :tm, :tn],
+        templates_seq=t_seq[:, :tT, :tn],
+        templates_coors=t_coors[:, :tT, :tn],
+        templates_mask=kw["templates_mask"][:, :tT, :tn],
+    )
 
     def loss(p):
         out = model.apply(p, seq, msa, **kw)
@@ -209,7 +220,9 @@ def config_5():
     )
     from alphafold2_tpu.train.loop import device_put_batch
 
-    state = init_end2end_state(cfg, model, batch)
+    from alphafold2_tpu.train.loop import tiny_batch_like
+
+    state = init_end2end_state(cfg, model, tiny_batch_like(batch))
     step = make_end2end_step(model, mesh=None)
     dev_batch = device_put_batch(batch)
     rng = jax.random.key(0)

@@ -38,7 +38,7 @@ def step_fits(crop: int, model_kw: dict) -> bool:
     from alphafold2_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
     from alphafold2_tpu.data.pipeline import SyntheticDataset
     from alphafold2_tpu.train.loop import (
-        build_model, device_put_batch, init_state, make_train_step,
+        build_model, device_put_batch, make_train_step, tiny_init_state,
     )
 
     cfg = Config(
@@ -50,7 +50,7 @@ def step_fits(crop: int, model_kw: dict) -> bool:
     try:
         batch = next(iter(SyntheticDataset(cfg.data, seed=0)))
         model = build_model(cfg)
-        state = init_state(cfg, model, batch)
+        state = tiny_init_state(cfg, model, batch)
         step = make_train_step(model, mesh=None)
         state, metrics = step(state, device_put_batch(batch), jax.random.key(0))
         jax.block_until_ready(metrics["loss"])

@@ -40,7 +40,7 @@ def main() -> int:
     from alphafold2_tpu.data.pipeline import make_dataset
     from alphafold2_tpu.train.loop import (
         apply_features, build_model, device_put_batch,
-        distogram_cross_entropy, init_state,
+        distogram_cross_entropy, tiny_init_state,
     )
     from alphafold2_tpu.utils import Kabsch, RMSD, TMscore, distogram_lddt, lddt
     from alphafold2_tpu.utils.structure import get_bucketed_distance_matrix
@@ -51,7 +51,9 @@ def main() -> int:
     ds = apply_features(iter(make_dataset(cfg.data, seed=args.seed)), cfg)
     model = build_model(cfg)
     sample = next(ds)
-    state = init_state(cfg, model, sample)
+    # params only (for the checkpoint restore target): tiny-sliced init
+    # skips the full-size forward compile
+    state = tiny_init_state(cfg, model, sample)
     params = state.params
     if args.checkpoint:
         from alphafold2_tpu.train.checkpoint import CheckpointManager

@@ -339,7 +339,7 @@ def stage_train_real():
                     "AF2TPU_TRAIN_REAL_CKPT", "/tmp/af2tpu_train_real_ckpt"
                 ),
                 hashlib.sha1(
-                    json.dumps([crop, train_shards]).encode()
+                    json.dumps([crop, steps, train_shards]).encode()
                 ).hexdigest()[:10],
             ),
         ),

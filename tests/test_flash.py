@@ -137,3 +137,67 @@ def test_context_parallel_excludes_compression(monkeypatch):
     with np.testing.assert_raises(AssertionError):
         b.apply(params_b, x, context=ctx)
     assert calls["n"] == 1
+
+
+def test_flash_pads_to_block_multiples(monkeypatch):
+    """The stock kernel hard-requires both sequence axes divisible by 128;
+    the wrapper must pad (mask-excluding the padding) and slice the output
+    — otherwise e.g. compressed-KV lengths silently fall back to dense."""
+    import jax.experimental.pallas.ops.tpu.flash_attention as stock
+
+    from alphafold2_tpu.ops import flash as flash_mod
+
+    seen = {}
+
+    def fake_kernel(q, k, v, *, segment_ids=None, sm_scale=1.0, **kw):
+        seen["nq"], seen["nk"] = q.shape[2], k.shape[2]
+        seen["seg"] = segment_ids
+        return jnp.zeros(q.shape, q.dtype)
+
+    monkeypatch.setattr(flash_mod, "flash_available", lambda: True)
+    monkeypatch.setattr(stock, "flash_attention", fake_kernel)
+
+    b, h, nq, nk, d = 1, 2, 200, 342, 16
+    q = jnp.ones((b, h, nq, d))
+    k = jnp.ones((b, h, nk, d))
+    v = jnp.ones((b, h, nk, d))
+    out = flash_mod.flash_attention(q, k, v)
+    assert out.shape == (b, h, nq, d)  # sliced back to the caller's nq
+    assert seen["nq"] == 256 and seen["nk"] == 384  # padded to 128 multiples
+    qs, ks = seen["seg"].q, seen["seg"].kv
+    # padding positions are mask-excluded (segment id 0 vs valid 1)
+    assert qs.shape == (b, 256) and ks.shape == (b, 384)
+    assert bool(qs[0, nq - 1]) and not bool(qs[0, nq])
+    assert bool(ks[0, nk - 1]) and not bool(ks[0, nk])
+
+    # aligned shapes with no masks still skip segment-id construction
+    q2 = jnp.ones((b, h, 128, d))
+    flash_mod.flash_attention(q2, q2, q2)
+    assert seen["seg"] is None
+
+
+def test_flash_engages_with_one_short_axis(monkeypatch):
+    # nq huge / nk sub-block (compressed context): the short axis is padded
+    # to one block instead of silently falling back to the dense path
+    import jax.experimental.pallas.ops.tpu.flash_attention as stock
+
+    from alphafold2_tpu.ops import flash as flash_mod
+
+    seen = {}
+
+    def fake_kernel(q, k, v, *, segment_ids=None, sm_scale=1.0, **kw):
+        seen["nk"] = k.shape[2]
+        return jnp.zeros(q.shape, q.dtype)
+
+    monkeypatch.setattr(flash_mod, "flash_available", lambda: True)
+    monkeypatch.setattr(stock, "flash_attention", fake_kernel)
+
+    q = jnp.ones((1, 2, 256, 16))
+    k = jnp.ones((1, 2, 86, 16))
+    out = flash_mod.flash_attention(q, k, k)
+    assert out.shape == (1, 2, 256, 16)
+    assert seen["nk"] == 128  # padded up to one block
+
+    # both axes sub-block: dense stays preferred
+    tiny = jnp.ones((1, 2, 64, 16))
+    assert flash_mod.flash_attention(tiny, tiny, tiny) is None
