@@ -30,6 +30,8 @@ there is no dead dense-dots compute (alphafold2.py:228).
 from __future__ import annotations
 
 import dataclasses
+import functools
+import warnings
 from typing import Optional
 
 import jax
@@ -56,6 +58,11 @@ class BlockSparseConfig:
     num_global_blocks: int = 1
     num_random_blocks: Optional[int] = None
     seed: int = 0
+    # kernel backend: "auto" = in-repo Pallas kernels on TPU / jnp gather
+    # elsewhere (the long-standing behavior); "pallas" / "jnp" force those;
+    # "splash" = the stock jax splash-attention kernel over the same layout
+    # (schedules only the layout's active blocks; fused custom-VJP backward)
+    backend: str = "auto"
 
     def resolve_random(self, seq_len: int) -> int:
         if self.num_random_blocks is not None:
@@ -202,6 +209,69 @@ def block_sparse_attention_pallas(
     return f(q, k, v, mask)
 
 
+_WARNED: set = set()
+
+
+@functools.lru_cache(maxsize=32)
+def _splash_kernel(layout_bytes: bytes, nb: int, block_size: int, heads: int,
+                   interpret: bool):
+    """Build (and cache) a splash MHA kernel for a static block layout —
+    mask preprocessing (MaskInfo construction) is trace-time work worth
+    doing once per (layout, heads) rather than per call."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as sm,
+    )
+
+    layout = np.frombuffer(layout_bytes, dtype=bool).reshape(nb, nb)
+    elem = np.kron(layout, np.ones((block_size, block_size), dtype=bool))
+    mh = sm.MultiHeadMask([sm.NumpyMask(elem)] * heads)
+    return sk.make_splash_mha(
+        mh, head_shards=1, q_seq_shards=1, interpret=interpret
+    )
+
+
+def block_sparse_attention_splash(
+    q, k, v, layout: np.ndarray, block_size: int, mask=None
+):
+    """The stock jax splash-attention kernel over the same static layout —
+    an alternative TPU backend to the in-repo Pallas kernels (fused
+    forward + custom-VJP backward, schedules only the layout's active
+    blocks). Padding composes via segment ids (valid=1, pad=0). Output at
+    PADDED query rows is unspecified and differs from the jnp oracle —
+    downstream masking makes those rows irrelevant (the loss excludes
+    masked pairs), and valid-region parity (values and grads) is proven in
+    interpret mode in tests/test_sparse.py."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+    )
+
+    b, h, n, d = q.shape
+    if n % 128 != 0:
+        # the splash kernel's q/kv block size is 128: shorter/unaligned
+        # sequences fall back to the gather oracle (same contract as
+        # ops/flash.py — warn once, never crash training)
+        key = f"splash_unaligned_{n}"
+        if key not in _WARNED:
+            _WARNED.add(key)
+            warnings.warn(
+                f"splash backend needs seq_len % 128 == 0, got {n}; "
+                "falling back to the jnp gather implementation"
+            )
+        return block_sparse_attention(q, k, v, layout, block_size, mask=mask)
+    nb = layout.shape[0]
+    kernel = _splash_kernel(
+        np.ascontiguousarray(layout).tobytes(), nb, block_size, h,
+        jax.default_backend() != "tpu",
+    )
+    seg = None
+    if mask is not None:
+        m = mask.astype(jnp.int32)
+        seg = sk.SegmentIds(q=m, kv=m)
+    out = jax.vmap(kernel)(q * (d**-0.5), k, v, segment_ids=seg)
+    return out.astype(q.dtype)
+
+
 class SparseAttention(nn.Module):
     """Block-sparse multi-head self-attention (drop-in for Attention).
 
@@ -227,6 +297,15 @@ class SparseAttention(nn.Module):
         self.out_dropout = nn.Dropout(self.dropout)
 
     def _impl(self):
+        backend = getattr(self.config, "backend", "auto")
+        # the explicit use_pallas bool predates config.backend and wins for
+        # back-compat; config.backend refines the default ("auto") policy
+        if self.use_pallas is None and backend != "auto":
+            return {
+                "jnp": block_sparse_attention,
+                "pallas": block_sparse_attention_pallas,
+                "splash": block_sparse_attention_splash,
+            }[backend]
         use_pallas = self.use_pallas
         if use_pallas is None:
             use_pallas = jax.default_backend() == "tpu"

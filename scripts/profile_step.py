@@ -30,7 +30,7 @@ def run_profiled_steps(trace_dir: str, n_steps: int = 3):
     from alphafold2_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
     from alphafold2_tpu.data.pipeline import SyntheticDataset
     from alphafold2_tpu.train.loop import (
-        build_model, device_put_batch, init_state, make_train_step,
+        build_model, device_put_batch, make_train_step, tiny_init_state,
     )
 
     e = lambda k, d: int(os.environ.get(k, d))
@@ -52,7 +52,7 @@ def run_profiled_steps(trace_dir: str, n_steps: int = 3):
     )
     batch = next(iter(SyntheticDataset(cfg.data, seed=0)))
     model = build_model(cfg)
-    state = init_state(cfg, model, batch)
+    state = tiny_init_state(cfg, model, batch)
     step = make_train_step(model, mesh=None)
     dev_batch = device_put_batch(batch)
     rng = jax.random.key(0)
