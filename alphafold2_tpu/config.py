@@ -26,6 +26,10 @@ class ModelConfig:
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
     remat: bool = False
+    # remat checkpoint policy: None/"nothing" (save nothing — max memory
+    # savings) | "dots" | "dots_no_batch" (save matmul outputs: backward
+    # skips recomputing MXU-heavy ops — the memory/MFU trade)
+    remat_policy: Optional[str] = None
     reversible: bool = False  # inversion-based O(1)-memory trunk engine
     sparse_self_attn: bool = False
     cross_attn_compress_ratio: int = 1

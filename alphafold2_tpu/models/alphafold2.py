@@ -117,6 +117,7 @@ class Alphafold2(nn.Module):
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
     remat: bool = False
+    remat_policy: Optional[str] = None  # None/"nothing" | "dots" | "dots_no_batch"
     reversible: bool = False  # true inversion-based reversible trunk engine
     sparse_self_attn: tuple | bool = False
     sparse_config: Optional[object] = None  # ops.sparse.BlockSparseConfig
@@ -295,6 +296,7 @@ class Alphafold2(nn.Module):
             use_flash=self.use_flash,
             grid_parallel=self.grid_parallel,
             remat=self.remat,
+            remat_policy=self.remat_policy,
             reversible=self.reversible,
             scan_layers=self.scan_layers,
             dtype=dt,

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -163,6 +164,31 @@ class TrunkLayer(nn.Module):
         return x, m
 
 
+def resolve_remat_policy(name):
+    """Map a config-level policy name to a jax.checkpoint policy.
+
+    None/"nothing" = save nothing (full recompute — max memory savings,
+    the long-standing behavior). "dots" / "dots_no_batch" save matmul
+    outputs ("no_batch" excludes batched dots): the backward pass skips
+    recomputing the MXU-heavy ops at the cost of keeping their outputs —
+    the standard memory/MFU trade on TPU.
+    """
+    if name is None or name == "nothing":
+        return None
+    policies = {
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_no_batch": (
+            jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        ),
+    }
+    if name not in policies:
+        raise ValueError(
+            f"unknown remat_policy {name!r}; have "
+            f"{[None, 'nothing', *policies]}"
+        )
+    return policies[name]
+
+
 class _ScanBody(nn.Module):
     """nn.scan body: carries (x, m) through one TrunkLayer; masks ride in
     as broadcast (loop-invariant) scan inputs."""
@@ -170,6 +196,7 @@ class _ScanBody(nn.Module):
     layer_kwargs: dict
     deterministic: bool
     remat: bool
+    remat_policy: Optional[str] = None
 
     @nn.compact
     def __call__(self, carry, pair_mask, msa_mask):
@@ -179,7 +206,8 @@ class _ScanBody(nn.Module):
             # prevent_cse=False: the CSE-prevention barriers jax.checkpoint
             # inserts by default are unnecessary (and costly) inside scan
             layer_cls = nn.remat(
-                TrunkLayer, static_argnums=(5,), prevent_cse=False
+                TrunkLayer, static_argnums=(5,), prevent_cse=False,
+                policy=resolve_remat_policy(self.remat_policy),
             )
         x, m = layer_cls(**self.layer_kwargs, name="layer")(
             x, m, pair_mask, msa_mask, self.deterministic
@@ -219,6 +247,7 @@ class Trunk(nn.Module):
     use_flash: Optional[bool] = None  # fused dense attention on TPU
     grid_parallel: bool = False  # 2D-sharded pair axial passes (spr x spc)
     remat: bool = False
+    remat_policy: Optional[str] = None  # None/"nothing" | "dots" | "dots_no_batch"
     reversible: bool = False  # inversion-based O(1)-memory engine
     scan_layers: bool = False
     dtype: jnp.dtype = jnp.float32
@@ -251,6 +280,21 @@ class Trunk(nn.Module):
         if not isinstance(sparse_flags, (tuple, list)):
             sparse_flags = (sparse_flags,) * self.depth
         assert len(sparse_flags) == self.depth
+
+        # validate eagerly: a policy name (even a typo) with remat off, or
+        # with the reversible engine (which never applies it), would
+        # otherwise be a silent no-op — the config asked for a memory/MFU
+        # trade that is not happening. "nothing" is the explicit spelling
+        # of the default and is always allowed.
+        if resolve_remat_policy(self.remat_policy) is not None and (
+            not self.remat or self.reversible
+        ):
+            raise ValueError(
+                f"remat_policy={self.remat_policy!r} has no effect "
+                + ("with the reversible engine (it has its own O(1)-memory "
+                   "schedule and never applies checkpoint policies)"
+                   if self.reversible else "without remat=True")
+            )
 
         if self.reversible:
             # true reversible coupling engine (reference reversible.py);
@@ -312,6 +356,7 @@ class Trunk(nn.Module):
                 layer_kwargs=self._layer_kwargs(sparse_flags[0]),
                 deterministic=deterministic,
                 remat=self.remat,
+                remat_policy=self.remat_policy,
                 name="scan",
             )
             (x, m), _ = scanned((x, m), pair_mask, msa_mask)
@@ -319,7 +364,10 @@ class Trunk(nn.Module):
 
         layer_cls = TrunkLayer
         if self.remat:
-            layer_cls = nn.remat(TrunkLayer, static_argnums=(5,))
+            layer_cls = nn.remat(
+                TrunkLayer, static_argnums=(5,),
+                policy=resolve_remat_policy(self.remat_policy),
+            )
 
         for i, sparse in enumerate(sparse_flags):
             x, m = layer_cls(

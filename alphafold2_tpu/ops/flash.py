@@ -26,6 +26,14 @@ import jax.numpy as jnp
 _WARNED = set()
 
 
+def warn_once(key: str, message: str) -> None:
+    """De-duplicated warning — trace-time fallbacks fire per call site but
+    should reach the user once (shared by the flash and sparse modules)."""
+    if key not in _WARNED:
+        _WARNED.add(key)
+        warnings.warn(message)
+
+
 def flash_available() -> bool:
     return jax.default_backend() == "tpu"
 
@@ -94,12 +102,10 @@ def flash_attention(
     try:
         out = _fa(q, k, v, segment_ids=segment_ids, sm_scale=sm_scale)
     except (ValueError, NotImplementedError) as e:
-        key = str(e)[:80]
-        if key not in _WARNED:
-            _WARNED.add(key)
-            warnings.warn(
-                f"flash attention unavailable for shape q={q.shape} "
-                f"k={k.shape}: {e}; using dense attention"
-            )
+        warn_once(
+            str(e)[:80],
+            f"flash attention unavailable for shape q={q.shape} "
+            f"k={k.shape}: {e}; using dense attention",
+        )
         return None
     return out[:, :, :nq] if pad_q else out

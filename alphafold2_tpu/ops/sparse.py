@@ -31,7 +31,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import warnings
 from typing import Optional
 
 import jax
@@ -209,9 +208,6 @@ def block_sparse_attention_pallas(
     return f(q, k, v, mask)
 
 
-_WARNED: set = set()
-
-
 @functools.lru_cache(maxsize=32)
 def _splash_kernel(layout_bytes: bytes, nb: int, block_size: int, heads: int,
                    interpret: bool):
@@ -247,17 +243,26 @@ def block_sparse_attention_splash(
     )
 
     b, h, n, d = q.shape
+    if jax.default_backend() != "tpu":
+        from alphafold2_tpu.ops.flash import warn_once
+
+        warn_once(
+            "splash_interpret",
+            "splash backend off-TPU runs the kernel in Pallas interpret "
+            "mode (orders of magnitude slower) — fine for tests, wrong "
+            "for real runs; use backend=\"auto\" or \"jnp\" off-TPU",
+        )
     if n % 128 != 0:
         # the splash kernel's q/kv block size is 128: shorter/unaligned
         # sequences fall back to the gather oracle (same contract as
         # ops/flash.py — warn once, never crash training)
-        key = f"splash_unaligned_{n}"
-        if key not in _WARNED:
-            _WARNED.add(key)
-            warnings.warn(
-                f"splash backend needs seq_len % 128 == 0, got {n}; "
-                "falling back to the jnp gather implementation"
-            )
+        from alphafold2_tpu.ops.flash import warn_once
+
+        warn_once(
+            f"splash_unaligned_{n}",
+            f"splash backend needs seq_len % 128 == 0, got {n}; "
+            "falling back to the jnp gather implementation",
+        )
         return block_sparse_attention(q, k, v, layout, block_size, mask=mask)
     nb = layout.shape[0]
     kernel = _splash_kernel(
@@ -300,12 +305,18 @@ class SparseAttention(nn.Module):
         backend = getattr(self.config, "backend", "auto")
         # the explicit use_pallas bool predates config.backend and wins for
         # back-compat; config.backend refines the default ("auto") policy
+        impls = {
+            "jnp": block_sparse_attention,
+            "pallas": block_sparse_attention_pallas,
+            "splash": block_sparse_attention_splash,
+        }
+        if backend != "auto" and backend not in impls:
+            raise ValueError(
+                f"unknown sparse backend {backend!r}; have "
+                f"{['auto', *impls]}"
+            )
         if self.use_pallas is None and backend != "auto":
-            return {
-                "jnp": block_sparse_attention,
-                "pallas": block_sparse_attention_pallas,
-                "splash": block_sparse_attention_splash,
-            }[backend]
+            return impls[backend]
         use_pallas = self.use_pallas
         if use_pallas is None:
             use_pallas = jax.default_backend() == "tpu"

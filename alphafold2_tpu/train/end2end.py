@@ -66,6 +66,7 @@ class End2EndModel(nn.Module):
     mds_iters: int = 200
     refiner_depth: int = 2
     remat: bool = False
+    remat_policy: "str | None" = None  # None/"nothing" | "dots" | "dots_no_batch"
     reversible: bool = False  # inversion-based trunk engine (needs MSA)
     msa_tie_row_attn: bool = False
     msa_row_shard: bool = False  # shard MSA rows over sp (tied-row psum)
@@ -84,7 +85,8 @@ class End2EndModel(nn.Module):
         logits = Alphafold2(
             dim=self.dim, depth=self.depth, heads=self.heads,
             dim_head=self.dim_head, max_seq_len=self.max_seq_len,
-            remat=self.remat, reversible=self.reversible,
+            remat=self.remat, remat_policy=self.remat_policy,
+            reversible=self.reversible,
             msa_tie_row_attn=self.msa_tie_row_attn,
             msa_row_shard=self.msa_row_shard,
             context_parallel=self.context_parallel,
@@ -253,7 +255,8 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
     model = End2EndModel(
         dim=cfg.model.dim, depth=cfg.model.depth, heads=cfg.model.heads,
         dim_head=cfg.model.dim_head, max_seq_len=cfg.model.max_seq_len,
-        remat=cfg.model.remat, reversible=cfg.model.reversible,
+        remat=cfg.model.remat, remat_policy=cfg.model.remat_policy,
+        reversible=cfg.model.reversible,
         msa_tie_row_attn=cfg.model.msa_tie_row_attn,
         msa_row_shard=cfg.model.msa_row_shard,
         context_parallel=cfg.model.context_parallel,

@@ -84,6 +84,7 @@ def build_model(cfg: Config) -> Alphafold2:
         attn_dropout=m.attn_dropout,
         ff_dropout=m.ff_dropout,
         remat=m.remat,
+        remat_policy=m.remat_policy,
         reversible=m.reversible,
         sparse_self_attn=m.sparse_self_attn,
         cross_attn_compress_ratio=m.cross_attn_compress_ratio,

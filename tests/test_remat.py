@@ -69,3 +69,59 @@ def test_remat_param_isomorphic():
     for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
         assert a.shape == b.shape
         assert np.allclose(a, b)
+
+
+def test_remat_policy_grad_parity():
+    """remat_policy="dots"/"dots_no_batch" (save matmul outputs, skip their
+    recompute in backward) must not change gradients — only the
+    memory/recompute schedule. Unknown policies fail loudly."""
+    import pytest
+
+    dim, n, m = 16, 6, 2
+    key = jax.random.key(10)
+    x = jax.random.normal(jax.random.fold_in(key, 1), (1, n, n, dim))
+    msa = jax.random.normal(jax.random.fold_in(key, 2), (1, m, n, dim))
+
+    def build(remat, policy=None, scan=False):
+        return Trunk(dim=dim, depth=2, heads=2, dim_head=8, remat=remat,
+                     remat_policy=policy, scan_layers=scan)
+
+    params = build(False).init(jax.random.key(3), x, msa)
+
+    def loss(trunk, params, x, msa):
+        xo, mo = trunk.apply(params, x, msa)
+        return jnp.sum(xo**2) + jnp.sum(mo**2)
+
+    g_plain = jax.grad(loss, argnums=(2, 3))(build(False), params, x, msa)
+    for policy in ("dots", "dots_no_batch", "nothing"):
+        g_pol = jax.grad(loss, argnums=(2, 3))(
+            build(True, policy), params, x, msa
+        )
+        for a, b in zip(g_plain, g_pol):
+            assert np.allclose(a, b, atol=1e-3), (
+                policy, np.abs(np.asarray(a - b)).max()
+            )
+
+    # scan_layers route applies the policy inside the scan body
+    scan_params = build(False, scan=True).init(jax.random.key(3), x, msa)
+    g_scan = jax.grad(loss, argnums=(2, 3))(
+        build(False, scan=True), scan_params, x, msa
+    )
+    g_scan_pol = jax.grad(loss, argnums=(2, 3))(
+        build(True, "dots", scan=True), scan_params, x, msa
+    )
+    for a, b in zip(g_scan, g_scan_pol):
+        assert np.allclose(a, b, atol=1e-3)
+
+    with pytest.raises(ValueError, match="unknown remat_policy"):
+        jax.grad(loss, argnums=(2,))(build(True, "bogus"), params, x, msa)
+
+    # a real policy without remat (or with the reversible engine) is a
+    # silent no-op the trunk must reject; "nothing" is the explicit default
+    # spelling and stays allowed
+    with pytest.raises(ValueError, match="has no effect"):
+        build(False, "dots").apply(params, x, msa)
+    with pytest.raises(ValueError, match="reversible"):
+        Trunk(dim=dim, depth=2, heads=2, dim_head=8, reversible=True,
+              remat=True, remat_policy="dots").init(jax.random.key(4), x, msa)
+    build(False, "nothing").apply(params, x, msa)  # alias of None: fine
