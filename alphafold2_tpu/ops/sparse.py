@@ -39,6 +39,7 @@ import numpy as np
 from flax import linen as nn
 
 from alphafold2_tpu.ops.attention import MASK_VALUE, grid_axial_project_attend
+from alphafold2_tpu.ops.flash import warn_once
 
 
 @dataclasses.dataclass(frozen=True)
@@ -243,27 +244,23 @@ def block_sparse_attention_splash(
     )
 
     b, h, n, d = q.shape
-    if jax.default_backend() != "tpu":
-        from alphafold2_tpu.ops.flash import warn_once
-
-        warn_once(
-            "splash_interpret",
-            "splash backend off-TPU runs the kernel in Pallas interpret "
-            "mode (orders of magnitude slower) — fine for tests, wrong "
-            "for real runs; use backend=\"auto\" or \"jnp\" off-TPU",
-        )
     if n % 128 != 0:
         # the splash kernel's q/kv block size is 128: shorter/unaligned
         # sequences fall back to the gather oracle (same contract as
         # ops/flash.py — warn once, never crash training)
-        from alphafold2_tpu.ops.flash import warn_once
-
         warn_once(
             f"splash_unaligned_{n}",
             f"splash backend needs seq_len % 128 == 0, got {n}; "
             "falling back to the jnp gather implementation",
         )
         return block_sparse_attention(q, k, v, layout, block_size, mask=mask)
+    if jax.default_backend() != "tpu":
+        warn_once(
+            "splash_interpret",
+            "splash backend off-TPU runs the kernel in Pallas interpret "
+            "mode (orders of magnitude slower) — fine for tests, wrong "
+            "for real runs; use backend=\"auto\" or \"jnp\" off-TPU",
+        )
     nb = layout.shape[0]
     kernel = _splash_kernel(
         np.ascontiguousarray(layout).tobytes(), nb, block_size, h,

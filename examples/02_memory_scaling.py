@@ -1,0 +1,73 @@
+"""Long-context / memory levers, composed in one model.
+
+The reference scales a single GPU with four mechanisms (sparse attention,
+KV-compressed cross-attention, tied-row MSA attention, a reversible
+trunk); this framework keeps all four — TPU-native — and adds fused flash
+kernels, XLA rematerialization with checkpoint policies, and mesh
+sharding (see 05_distributed_training.py).
+
+Run anywhere:  python examples/02_memory_scaling.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu.models import Alphafold2
+from alphafold2_tpu.ops.sparse import BlockSparseConfig
+
+TINY = os.environ.get("EX_TINY") == "1"
+DIM, N, M = (32, 32, 2) if TINY else (128, 128, 8)
+
+model = Alphafold2(
+    dim=DIM,
+    depth=2,
+    heads=2,
+    dim_head=16,
+    max_seq_len=2 * N,
+    # interleave block-sparse pair self-attention (reference README
+    # "Sparse Attention": (True, False) per depth step, DeepSpeed block
+    # sparsity -> here an in-repo Pallas kernel, a splash-attention
+    # backend, and a jnp gather oracle, selected by config.backend)
+    sparse_self_attn=(True, False),
+    sparse_config=BlockSparseConfig(block_size=16, num_random_blocks=1),
+    # compress cross-attention keys/values 2x (reference README
+    # "Memory Compressed Attention"); composes with the flash kernel
+    cross_attn_compress_ratio=2,
+    # one shared attention matrix across MSA rows (reference README
+    # "MSA Tied Row Attention") — with EXACT mask semantics (padded
+    # entries abstain; the reference forbids masks here)
+    msa_tie_row_attn=True,
+    # O(1)-in-depth activation memory: XLA rematerialization...
+    remat=True,
+    # ...saving matmul outputs so the backward skips recomputing the
+    # MXU-heavy ops (memory <-> MFU trade; "dots_no_batch" saves less)
+    remat_policy="dots",
+    # reversible=True instead gives the inversion-based engine — the
+    # reference's reversible trunk, as a lax.scan + custom_vjp
+)
+
+key = jax.random.key(0)
+seq = jax.random.randint(jax.random.fold_in(key, 1), (1, N), 0, 21)
+msa = jax.random.randint(jax.random.fold_in(key, 2), (1, M, N), 0, 21)
+mask = jnp.ones((1, N), dtype=bool)
+msa_mask = jnp.ones((1, M, N), dtype=bool)
+
+params = model.init(key, seq, msa, mask=mask, msa_mask=msa_mask)
+
+
+def loss(p):
+    return jnp.mean(
+        model.apply(p, seq, msa, mask=mask, msa_mask=msa_mask) ** 2
+    )
+
+
+val, grads = jax.jit(jax.value_and_grad(loss))(params)
+n_leaves = len(jax.tree.leaves(grads))
+print(f"loss={float(val):.4f}, {n_leaves} gradient leaves, all finite:",
+      all(bool(jnp.all(jnp.isfinite(g))) for g in jax.tree.leaves(grads)))
+print("ok")

@@ -1,0 +1,63 @@
+"""Template conditioning and the PLM-embedding input path.
+
+Reference README "Templates" (template sequences + coordinates, optional
+sidechain SE(3) coloring) and the ESM/PLM ``embedds`` path (broken
+upstream — SURVEY.md S2.5 — working here: the projected embedding
+outer-sum becomes an (N, N) grid standing in for the MSA stream).
+
+Run anywhere:  python examples/03_templates_and_plm.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu import constants
+from alphafold2_tpu.models import Alphafold2
+
+TINY = os.environ.get("EX_TINY") == "1"
+DIM, N, T = (32, 24, 2) if TINY else (64, 64, 2)
+
+model = Alphafold2(
+    dim=DIM, depth=1, heads=2, dim_head=16, max_seq_len=2 * N,
+    template_attn_depth=1,
+)
+
+key = jax.random.key(0)
+seq = jax.random.randint(jax.random.fold_in(key, 1), (1, N), 0, 21)
+mask = jnp.ones((1, N), dtype=bool)
+
+# templates: aligned sequences + CA coordinates (+ unit sidechain vectors
+# for the SE(3) template embedder); the distogram is auto-bucketed from
+# the coordinates when not given (reference alphafold2.py:508-509)
+t_seq = jax.random.randint(jax.random.fold_in(key, 2), (1, T, N), 0, 21)
+t_coors = jax.random.normal(jax.random.fold_in(key, 3), (1, T, N, 3)) * 10
+t_side = jax.random.normal(jax.random.fold_in(key, 4), (1, T, N, 3))
+t_side = t_side / jnp.linalg.norm(t_side, axis=-1, keepdims=True)
+t_mask = jnp.ones((1, T, N), dtype=bool)
+
+kw = dict(
+    mask=mask,
+    templates_seq=t_seq,
+    templates_coors=t_coors,
+    templates_mask=t_mask,
+    templates_sidechains=t_side,
+)
+params = model.init(key, seq, **kw)
+out = jax.jit(lambda p: model.apply(p, seq, **kw))(params)
+print("templated distogram:", out.shape)
+
+# PLM path: precomputed language-model residue embeddings instead of an MSA
+plm = Alphafold2(dim=DIM, depth=1, heads=2, dim_head=16, max_seq_len=2 * N)
+embedds = jax.random.normal(
+    jax.random.fold_in(key, 5), (1, N, constants.NUM_EMBEDDS_TR)
+)
+p2 = plm.init(key, seq, mask=mask, embedds=embedds)
+out2 = jax.jit(lambda p: plm.apply(p, seq, mask=mask, embedds=embedds))(p2)
+print("plm-conditioned distogram:", out2.shape)
+assert out.shape == out2.shape == (1, N, N, 37)
+print("ok")
