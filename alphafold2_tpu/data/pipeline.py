@@ -26,7 +26,7 @@ Batches are dicts of numpy arrays:
 from __future__ import annotations
 
 import dataclasses
-from typing import Iterator, Optional
+from typing import Iterator
 
 import numpy as np
 

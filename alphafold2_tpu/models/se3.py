@@ -25,8 +25,6 @@ in tests/test_se3.py (the reference has no such test).
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 from flax import linen as nn

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from alphafold2_tpu.ops.attention import MASK_VALUE
 

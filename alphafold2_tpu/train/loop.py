@@ -20,8 +20,7 @@ gradient accumulation 16 — train_pre.py:13-24, 66-95) re-designed TPU-first:
 from __future__ import annotations
 
 from contextlib import nullcontext
-from functools import partial
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
