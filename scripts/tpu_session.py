@@ -49,7 +49,11 @@ import alphafold2_tpu
 alphafold2_tpu.setup_platform()  # AF2TPU_PLATFORM=cpu for host-side smokes
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT_PATH = os.path.join(REPO, "TPU_SESSION.json")
+# AF2TPU_SESSION_OUT redirects the results file — e.g. a CPU-side
+# train_real run must not clobber a concurrent real-TPU session's results
+OUT_PATH = os.environ.get(
+    "AF2TPU_SESSION_OUT", os.path.join(REPO, "TPU_SESSION.json")
+)
 _T0 = time.monotonic()
 DEADLINE = int(os.environ.get("AF2TPU_SESSION_DEADLINE", 10800))
 STAGE_DEADLINE = int(os.environ.get("AF2TPU_STAGE_DEADLINE", 2400))
