@@ -107,6 +107,11 @@ def main():
         ("block-sparse+remat", dict(dim=dim, depth=depth, heads=8, dim_head=dh,
                                     remat=True, sparse_self_attn=True,
                                     msa_tie_row_attn=True, bfloat16=True)),
+        # remat_policy="dots" keeps matmul outputs (backward skips their
+        # recompute): how much peak crop does the MFU trade cost?
+        ("dense+remat-dots", dict(dim=dim, depth=depth, heads=8, dim_head=dh,
+                                  remat=True, remat_policy="dots",
+                                  msa_tie_row_attn=True, bfloat16=True)),
     ]
     out = {"device": jax.devices()[0].device_kind, "smoke": SMOKE,
            "msa": "16 x crop", "results": []}
