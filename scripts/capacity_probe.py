@@ -3,7 +3,7 @@
 BASELINE.md's second metric is "peak MSA x seq_len per chip: measure &
 maximize". This driver binary-searches the largest crop that completes a
 full training step (fwd+bwd+opt) on the attached accelerator for each of a
-few engine configs (dense+remat, reversible, block-sparse), at fixed MSA
+few engine configs (dense+remat, reversible, block-sparse, dense+remat-dots), at fixed MSA
 16 x crop, and writes CAPACITY.json.
 
 Each probe costs a compile, so the search is bounded (MAX_PROBES per

@@ -159,3 +159,50 @@ def test_device_prefetch_order_and_exhaustion():
     got = [int(b["seq"][0, 0]) for b in device_prefetch(iter(batches[:1]), size=3)]
     assert got == [0]
     assert list(device_prefetch(iter([]), size=2)) == []
+
+
+def test_bench_preflight_switches_compile_mode(monkeypatch):
+    """bench.py's preflight: a dead remote-compile endpoint with a working
+    client-compile mode must re-exec with PALLAS_AXON_REMOTE_COMPILE=0
+    (the observed round-2 failure mode: init fine, first compile hangs)."""
+    import subprocess
+    import types
+
+    import bench
+
+    monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "1")
+    monkeypatch.delenv("AF2TPU_PLATFORM", raising=False)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("AF2TPU_NO_PREFLIGHT", raising=False)
+
+    calls = []
+
+    def fake_run(cmd, env=None, timeout=None, capture_output=None):
+        mode = (env or {}).get("PALLAS_AXON_REMOTE_COMPILE")
+        calls.append(mode)
+        # remote mode (1) broken; client mode (0) healthy
+        return types.SimpleNamespace(returncode=0 if mode == "0" else 1)
+
+    execs = []
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(
+        bench.os, "execv", lambda *a: execs.append(a) or (_ for _ in ()).throw(SystemExit)
+    )
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit):
+        bench._preflight_compile_mode()
+    assert calls == ["1", "0"]
+    assert bench.os.environ["PALLAS_AXON_REMOTE_COMPILE"] == "0"
+    assert execs  # re-exec'd
+
+    # healthy remote mode: no re-exec
+    calls.clear()
+    execs.clear()
+    monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "1")
+    monkeypatch.setattr(
+        subprocess, "run",
+        lambda *a, **kw: types.SimpleNamespace(returncode=0),
+    )
+    bench._preflight_compile_mode()
+    assert not execs
