@@ -42,7 +42,9 @@ WARMUP = _env_int("AF2TPU_BENCH_WARMUP", 3)
 ITERS = _env_int("AF2TPU_BENCH_ITERS", 10)
 # steps chained in-graph per dispatch (lax.scan): isolates device throughput
 # from host/tunnel dispatch latency
-INGRAPH = _env_int("AF2TPU_BENCH_INGRAPH", 4)
+INGRAPH = _env_int("AF2TPU_BENCH_INGRAPH", 8)  # scan trip count: compile
+# cost is INGRAPH-independent, and 8 halves the per-dispatch tunnel-latency
+# share vs 4
 # total wall-clock budget (s): the bench must emit its JSON line before the
 # driver's own timeout would kill it with nothing on stdout (round 1 lost
 # both artifacts to rc=124). Healthy flagship runs finish in well under half
