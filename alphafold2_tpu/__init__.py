@@ -33,6 +33,42 @@ def setup_platform(default: str | None = None) -> None:
     enable_compile_cache()
 
 
+def compile_cache_dir() -> str:
+    """The persistent XLA compile cache location. Per-user (not a fixed
+    world-readable /tmp path — on a shared host another user could
+    pre-create it and poison serialized executables this process would
+    deserialize). ``AF2TPU_COMPILE_CACHE`` overrides; empty disables."""
+    override = _os.environ.get("AF2TPU_COMPILE_CACHE")
+    if override is not None:  # set (possibly empty = disabled): the
+        return override  # per-user default must not even be touched
+    return _os.path.join(user_cache_dir(), "xla_cache")
+
+
+def user_cache_dir() -> str:
+    """Per-user scratch root for caches/checkpoints/shards (mode 0700).
+
+    A pre-existing directory is validated: it must belong to this uid
+    (anything else is refused — a directory planted by another user could
+    feed poisoned serialized executables) and is tightened to 0700 if a
+    prior process left it group/other-accessible."""
+    root = _os.path.join(
+        _os.path.expanduser("~") if _os.path.expanduser("~") != "~"
+        else "/tmp/af2tpu_u%d" % _os.getuid(),
+        ".cache", "af2tpu",
+    )
+    _os.makedirs(root, mode=0o700, exist_ok=True)
+    st = _os.stat(root)
+    if st.st_uid != _os.getuid():
+        raise RuntimeError(
+            f"refusing cache dir {root}: owned by uid {st.st_uid}, not "
+            f"{_os.getuid()} — set AF2TPU_COMPILE_CACHE (and the other "
+            "AF2TPU_* path overrides) to a directory you own"
+        )
+    if st.st_mode & 0o077:
+        _os.chmod(root, 0o700)
+    return root
+
+
 def enable_compile_cache() -> None:
     """Point XLA's persistent compilation cache at a stable directory.
 
@@ -42,10 +78,22 @@ def enable_compile_cache() -> None:
     compiling the same HLO (the round-end bench after a measurement
     session, a session relaunched after a tunnel drop) reuses the
     serialized executable in seconds. Best-effort: backends that cannot
-    serialize executables simply miss the cache. ``AF2TPU_COMPILE_CACHE=``
-    (empty) disables."""
-    cache_dir = _os.environ.get("AF2TPU_COMPILE_CACHE", "/tmp/af2tpu_xla_cache")
-    if not cache_dir:
+    serialize executables simply miss the cache."""
+    # fully best-effort: this runs from setup_platform at driver import
+    # time, and a raise here (unwritable path, foreign-owned dir) would
+    # kill bench.py before its watchdog/JSON-record machinery exists —
+    # running without a cache is always better than not running
+    try:
+        cache_dir = compile_cache_dir()
+        if not cache_dir:
+            return
+        _os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+    except (OSError, RuntimeError) as e:
+        import sys as _sys
+
+        print(
+            f"alphafold2_tpu: compile cache disabled ({e})", file=_sys.stderr
+        )
         return
     import jax
 

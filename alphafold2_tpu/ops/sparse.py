@@ -209,20 +209,73 @@ def block_sparse_attention_pallas(
     return f(q, k, v, mask)
 
 
+@functools.lru_cache(maxsize=1)
+def _block_layout_mask_cls():
+    """The splash Mask subclass, built once (its base class lives inside
+    the lazily-imported splash module). Module-level caching keeps mask
+    equality/hashing stable across _splash_kernel calls — a per-call class
+    would break __eq__'s isinstance against previously built masks."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_mask as sm,
+    )
+
+    class _BlockLayoutMask(sm.Mask):
+        """Element-level view of a block-level layout, evaluated lazily:
+        __getitem__ maps the requested element indices to layout blocks,
+        touching only the requested chunk — nothing O(n^2) is ever
+        materialized, at any sequence length (ADVICE r2)."""
+
+        def __init__(self, layout: np.ndarray, block_size: int):
+            self._layout = layout
+            self._bs = block_size
+
+        @property
+        def shape(self):
+            return (
+                self._layout.shape[0] * self._bs,
+                self._layout.shape[1] * self._bs,
+            )
+
+        def __getitem__(self, idx) -> np.ndarray:
+            if not isinstance(idx, tuple) or len(idx) != 2:
+                raise NotImplementedError(f"unsupported mask index {idx!r}")
+            r = np.arange(self.shape[0])[idx[0]] // self._bs
+            c = np.arange(self.shape[1])[idx[1]] // self._bs
+            if r.ndim == 1 and c.ndim == 1:
+                return self._layout[np.ix_(r, c)]
+            return self._layout[r, c]
+
+        def __eq__(self, other):
+            if not isinstance(other, _BlockLayoutMask):
+                return NotImplemented
+            return self._bs == other._bs and np.array_equal(
+                self._layout, other._layout
+            )
+
+        def __hash__(self):
+            return hash(
+                (type(self).__name__, self._bs, self._layout.tobytes())
+            )
+
+    return _BlockLayoutMask
+
+
 @functools.lru_cache(maxsize=32)
 def _splash_kernel(layout_bytes: bytes, nb: int, block_size: int, heads: int,
                    interpret: bool):
     """Build (and cache) a splash MHA kernel for a static block layout —
     mask preprocessing (MaskInfo construction) is trace-time work worth
-    doing once per (layout, heads) rather than per call."""
+    doing once per (layout, heads) rather than per call. The mask is
+    served lazily from the (nb, nb) block layout via _block_layout_mask_cls
+    (no dense element-level materialization)."""
     from jax.experimental.pallas.ops.tpu.splash_attention import (
         splash_attention_kernel as sk,
         splash_attention_mask as sm,
     )
 
     layout = np.frombuffer(layout_bytes, dtype=bool).reshape(nb, nb)
-    elem = np.kron(layout, np.ones((block_size, block_size), dtype=bool))
-    mh = sm.MultiHeadMask([sm.NumpyMask(elem)] * heads)
+    mask_cls = _block_layout_mask_cls()
+    mh = sm.MultiHeadMask([mask_cls(layout, block_size)] * heads)
     return sk.make_splash_mha(
         mh, head_shards=1, q_seq_shards=1, interpret=interpret
     )

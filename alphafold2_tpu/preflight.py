@@ -1,0 +1,99 @@
+"""Axon-relay compile-mode preflight, shared by every TPU driver.
+
+Observed failure mode (see bench.py and scripts/tpu_session.py): the
+tunneled TPU relay's backend init succeeds but its /remote_compile HTTP
+endpoint is dead — the first jax computation then hangs inside C++ with no
+timeout (a 50-minute session was lost to exactly this in round 2). The
+compile mode is fixed at interpreter start (the site hook reads
+``PALLAS_AXON_REMOTE_COMPILE`` when it registers the PJRT plugin), so the
+probe must run in subprocesses and switching modes requires re-exec'ing the
+current driver.
+
+``preflight_compile_mode`` is called by drivers (bench.py __main__,
+scripts/tpu_session.py main) BEFORE their first jax computation. It either
+returns a status string or — when remote compile is dead but client-side
+compile works — re-execs the current process with
+``PALLAS_AXON_REMOTE_COMPILE=0`` (never returns).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_PROBE = (
+    "import jax, jax.numpy as jnp; "
+    "assert float(jnp.ones((8, 8)).sum()) == 64.0"
+)
+
+
+def _probe_ok(extra_env: dict | None = None, timeout: int = 240) -> bool:
+    """Run one tiny jax computation in a subprocess; True iff it completes."""
+    try:
+        return (
+            subprocess.run(
+                [sys.executable, "-c", _PROBE],
+                env={**os.environ, **(extra_env or {})},
+                timeout=timeout,
+                capture_output=True,
+            ).returncode
+            == 0
+        )
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def preflight_compile_mode(
+    remaining_fn=None,
+    deadline_env_var: str | None = None,
+    probe_timeout: int = 240,
+) -> str:
+    """Probe the relay's compile modes; re-exec into client-side compile if
+    that is the only working mode.
+
+    Returns one of:
+      ``"skipped"``         — host-side CPU run or already client-compile
+                              mode; nothing to probe
+      ``"remote_ok"``       — remote compile answered the probe
+      ``"both_dead"``       — neither mode completed a computation (callers'
+                              own watchdogs/retries take it from here)
+    and does NOT return (``os.execv``) when remote compile is dead but
+    client-side compile works.
+
+    ``remaining_fn``/``deadline_env_var``: a re-exec resets the new
+    interpreter's clock, so the caller hands a zero-arg callable returning
+    its remaining budget in seconds; it is evaluated immediately before
+    exec (the probes themselves burn up to 2 x ``probe_timeout`` — a value
+    computed at call time would overstate the child's budget by that much)
+    and written into the caller's deadline env var (e.g.
+    ``AF2TPU_BENCH_DEADLINE``, ``AF2TPU_SESSION_DEADLINE``).
+    """
+    if (
+        os.environ.get("AF2TPU_PLATFORM") == "cpu"
+        or os.environ.get("JAX_PLATFORMS") == "cpu"
+        or os.environ.get("AF2TPU_NO_PREFLIGHT") == "1"
+    ):
+        return "skipped"
+    if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") != "1":
+        return "skipped"  # already client-compile mode (or no relay at all)
+
+    if _probe_ok(timeout=probe_timeout):
+        return "remote_ok"
+    if _probe_ok({"PALLAS_AXON_REMOTE_COMPILE": "0"}, timeout=probe_timeout):
+        print(
+            "preflight: remote-compile endpoint unhealthy but client-side "
+            "compile works; re-exec with PALLAS_AXON_REMOTE_COMPILE=0",
+            file=sys.stderr,
+            flush=True,
+        )
+        os.environ["PALLAS_AXON_REMOTE_COMPILE"] = "0"
+        # the re-exec'd process skips the probe (mode already 0) but must
+        # still know the tunnel was just proven alive (cold-cache budgeting)
+        os.environ["AF2TPU_PREFLIGHT_CLIENT_OK"] = "1"
+        if deadline_env_var and remaining_fn is not None:
+            os.environ[deadline_env_var] = str(
+                max(1, int(remaining_fn()))
+            )
+        os.execv(sys.executable, [sys.executable] + sys.argv)
+    return "both_dead"

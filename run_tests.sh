@@ -3,6 +3,23 @@
 # PALLAS_AXON_POOL_IPS= disables the axon TPU relay hook in sitecustomize
 # (it serializes every jax process through a single tunnel — tests must not
 # touch it). See tests/conftest.py for the in-process fallback.
+#
+# Builds native/ first: without libaf2data.so the 14 C++-loader tests
+# silently skip (VERDICT r2 weak #5), and a canonical run must not
+# under-test. A missing toolchain fails LOUDLY; export AF2TPU_SKIP_NATIVE=1
+# to opt out explicitly on toolchain-less hosts.
+set -e
+cd "$(dirname "$0")"
+if [ "${AF2TPU_SKIP_NATIVE}" != "1" ]; then
+  command -v "${CXX:-g++}" >/dev/null || {
+    echo "run_tests.sh: ${CXX:-g++} not found — native/ cannot build, and" >&2
+    echo "without libaf2data.so 14 loader tests silently skip. Install a" >&2
+    echo "C++ toolchain (or export CXX) or set AF2TPU_SKIP_NATIVE=1 to" >&2
+    echo "accept the skips." >&2
+    exit 1
+  }
+  make -C native all >/dev/null
+fi
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -m pytest "${@:-tests/}" -q

@@ -2,7 +2,7 @@
 the top ops by self time, aggregated from the trace's XLA-op events.
 
 Usage: python scripts/profile_step.py [overrides like AF2TPU_BENCH_* env]
-Writes the raw jax.profiler trace under /tmp/af2tpu_profile (inspect with
+Writes the raw jax.profiler trace under ~/.cache/af2tpu/profile (inspect with
 tensorboard if available) and prints a text summary so no external viewer
 is needed.
 """
@@ -122,7 +122,14 @@ def summarize(trace_dir: str, n_steps: int, top: int = 30):
 
 
 if __name__ == "__main__":
-    trace_dir = os.environ.get("AF2TPU_TRACE_DIR", "/tmp/af2tpu_profile")
+    import alphafold2_tpu
+
+    # same default as tpu_session's stage_profile: per-user, not a fixed
+    # world-writable /tmp path (and standalone + session runs share traces)
+    trace_dir = os.environ.get(
+        "AF2TPU_TRACE_DIR",
+        os.path.join(alphafold2_tpu.user_cache_dir(), "profile"),
+    )
     n = int(os.environ.get("AF2TPU_PROFILE_STEPS", 3))
     run_profiled_steps(trace_dir, n)
     summarize(trace_dir, n)

@@ -317,7 +317,10 @@ def stage_train_real():
     import jax
     import jax.numpy as jnp
 
-    shard_dir = os.environ.get("AF2TPU_REAL_SHARDS", "/tmp/af2tpu_real_shards")
+    shard_dir = os.environ.get(
+        "AF2TPU_REAL_SHARDS",
+        os.path.join(alphafold2_tpu.user_cache_dir(), "real_shards"),
+    )
     pdb_dir = os.environ.get("AF2TPU_REAL_PDB_DIR")
     have_shards = os.path.isdir(shard_dir) and any(
         f.endswith(".npz") for f in os.listdir(shard_dir)
@@ -390,7 +393,10 @@ def stage_train_real():
             # the restored weights actually trained on
             checkpoint_dir=os.path.join(
                 os.environ.get(
-                    "AF2TPU_TRAIN_REAL_CKPT", "/tmp/af2tpu_train_real_ckpt"
+                    "AF2TPU_TRAIN_REAL_CKPT",
+                    os.path.join(
+                        alphafold2_tpu.user_cache_dir(), "train_real_ckpt"
+                    ),
                 ),
                 hashlib.sha1(
                     json.dumps([crop, steps, train_shards]).encode()
@@ -448,7 +454,10 @@ def stage_train_real():
 
 def stage_profile():
     mod = importlib.import_module("profile_step")
-    trace_dir = os.environ.get("AF2TPU_TRACE_DIR", "/tmp/af2tpu_profile")
+    trace_dir = os.environ.get(
+        "AF2TPU_TRACE_DIR",
+        os.path.join(alphafold2_tpu.user_cache_dir(), "profile"),
+    )
     n = int(os.environ.get("AF2TPU_PROFILE_STEPS", 3))
     mod.run_profiled_steps(trace_dir, n_steps=n)
     mod.summarize(trace_dir, n, top=30)
@@ -488,7 +497,7 @@ def main():
         time.sleep(max(0.0, DEADLINE - (time.monotonic() - _T0)))
         RESULTS["deadline_exceeded"] = DEADLINE
         _flush()
-        os._exit(0)
+        os._exit(75)  # nonzero: the session was truncated, not completed
 
     if DEADLINE > 0:
         threading.Thread(target=_watchdog, daemon=True).start()
@@ -522,12 +531,19 @@ def main():
                 remaining = [name] + remaining
             relaunches = int(os.environ.get("AF2TPU_SESSION_RELAUNCHES", 4))
             elapsed = time.monotonic() - _T0
+            budget_left = (
+                DEADLINE - elapsed if DEADLINE > 0 else float("inf")
+            )
             if (
                 not remaining
                 or relaunches <= 0
-                or (DEADLINE > 0 and elapsed > DEADLINE - STAGE_DEADLINE / 2)
+                or budget_left <= STAGE_DEADLINE / 2
             ):
-                os._exit(0)
+                # no relaunch when the session budget is exhausted (a child
+                # would overrun the configured bound), and NONZERO exit: a
+                # stage was abandoned on timeout, and wrappers must be able
+                # to tell this truncated session from a clean one
+                os._exit(75)
             print(
                 f"stage {name} exceeded {STAGE_DEADLINE}s; re-exec for "
                 f"{remaining}", flush=True,
@@ -536,10 +552,8 @@ def main():
             os.environ["AF2TPU_SESSION_RESUME"] = "1"
             if DEADLINE > 0:
                 # the child's fresh _T0 must not reset the session bound:
-                # hand it only the remaining budget
-                os.environ["AF2TPU_SESSION_DEADLINE"] = str(
-                    max(int(DEADLINE - elapsed), int(STAGE_DEADLINE / 2))
-                )
+                # hand it only the true remaining budget (never clamped up)
+                os.environ["AF2TPU_SESSION_DEADLINE"] = str(int(budget_left))
             os.execv(
                 sys.executable,
                 [sys.executable, os.path.abspath(__file__)] + remaining + flags,
@@ -547,6 +561,26 @@ def main():
 
     if STAGE_DEADLINE > 0:
         threading.Thread(target=_stage_watchdog, daemon=True).start()
+
+    # Probe the relay's compile mode BEFORE the first stage touches jax
+    # (ADVICE r2): stage_bench calls bench.main() in-process, which never
+    # runs bench's __main__ preflight — facing a dead /remote_compile
+    # endpoint, every stage would hang for the full STAGE_DEADLINE and the
+    # relaunch would retry the same dead mode. Probing here re-execs this
+    # driver into PALLAS_AXON_REMOTE_COMPILE=0 once, up front. Runs AFTER
+    # the watchdog threads start: the probes (2 x 240s) must not outlive a
+    # short session deadline with nothing flushed.
+    from alphafold2_tpu.preflight import preflight_compile_mode
+
+    RESULTS["preflight"] = preflight_compile_mode(
+        # evaluated right before a re-exec, AFTER the probes have burned
+        # their share of the budget
+        remaining_fn=(
+            (lambda: max(1, int(DEADLINE - (time.monotonic() - _T0))))
+            if DEADLINE > 0 else None
+        ),
+        deadline_env_var="AF2TPU_SESSION_DEADLINE",
+    )
 
     requested = [a for a in sys.argv[1:] if not a.startswith("-")]
     names = requested or list(STAGES)

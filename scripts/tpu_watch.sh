@@ -1,0 +1,39 @@
+#!/bin/bash
+# Round-long TPU-tunnel watcher: probe every cycle; at the FIRST healthy
+# window run the full measurement session (scripts/tpu_session.py), which
+# warms the persistent compile cache and re-records bench_baseline.json so
+# the driver's round-end bench.py lands a real number (VERDICT r2 #1).
+#
+# Run as a foreground background-task (NOT nohup/setsid — those get swept
+# when the launching task ends). Probes try remote-compile first, then
+# client-side compile: either one alive is a usable window (the session's
+# startup preflight picks the right mode itself).
+cd "$(dirname "$0")/.." || exit 1
+# probe logic lives in ONE place (alphafold2_tpu.preflight); the watcher
+# must agree with the session's own preflight about what "healthy" means.
+# _probe_ok runs its jax subprocess under its own 240s timeout; the outer
+# 300s timeout is a backstop, not the probe budget.
+PROBE='import sys; from alphafold2_tpu.preflight import _probe_ok; sys.exit(0 if _probe_ok() else 1)'
+CYCLES=${AF2TPU_WATCH_CYCLES:-60}
+SLEEP=${AF2TPU_WATCH_SLEEP:-360}
+for i in $(seq 1 "$CYCLES"); do
+  echo "[watch] probe $i/$CYCLES $(date +%H:%M:%S)"
+  ok=""
+  if timeout 300 python -c "$PROBE" >/dev/null 2>&1; then
+    ok="remote"
+  elif PALLAS_AXON_REMOTE_COMPILE=0 timeout 300 python -c "$PROBE" >/dev/null 2>&1; then
+    ok="client"
+  fi
+  if [ -n "$ok" ]; then
+    echo "[watch] tunnel healthy ($ok-compile) at $(date +%H:%M:%S); launching tpu_session"
+    AF2TPU_SESSION_DEADLINE=${AF2TPU_WATCH_SESSION_DEADLINE:-9000} \
+      AF2TPU_REAL_PDB_DIR=${AF2TPU_REAL_PDB_DIR:-/root/reference/notebooks/data} \
+      python scripts/tpu_session.py "$@"
+    rc=$?
+    echo "[watch] session rc=$rc"
+    exit $rc
+  fi
+  sleep "$SLEEP"
+done
+echo "[watch] no healthy window in $CYCLES cycles"
+exit 1

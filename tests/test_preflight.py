@@ -1,0 +1,89 @@
+"""The shared compile-mode preflight (alphafold2_tpu.preflight) and bench's
+cold-cache deadline budgeting around it.
+
+The real probe launches jax subprocesses against the axon relay; here the
+probe is monkeypatched — what's under test is the decision logic: when to
+skip, when to report both modes dead, and when to flip to client-side
+compile and re-exec with the remaining budget.
+"""
+
+import os
+
+import pytest
+
+from alphafold2_tpu import preflight
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in (
+        "AF2TPU_PLATFORM", "JAX_PLATFORMS", "AF2TPU_NO_PREFLIGHT",
+        "PALLAS_AXON_REMOTE_COMPILE", "AF2TPU_PREFLIGHT_CLIENT_OK",
+        "AF2TPU_BENCH_DEADLINE",
+    ):
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_skipped_on_cpu_platform(monkeypatch):
+    monkeypatch.setenv("AF2TPU_PLATFORM", "cpu")
+    monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "1")
+    assert preflight.preflight_compile_mode() == "skipped"
+
+
+def test_skipped_when_already_client_mode(monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "0")
+    assert preflight.preflight_compile_mode() == "skipped"
+
+
+def test_remote_ok(monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "1")
+    monkeypatch.setattr(preflight, "_probe_ok", lambda *a, **k: True)
+    assert preflight.preflight_compile_mode() == "remote_ok"
+
+
+def test_both_dead(monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "1")
+    monkeypatch.setattr(preflight, "_probe_ok", lambda *a, **k: False)
+    assert preflight.preflight_compile_mode() == "both_dead"
+
+
+def test_reexec_into_client_mode(monkeypatch):
+    # remote probe fails, client probe succeeds -> env flipped, remaining
+    # budget written into the caller's deadline var, execv with sys.argv
+    monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "1")
+    calls = []
+
+    def fake_probe(extra_env=None, timeout=240):
+        return bool(extra_env)  # plain probe False, client-mode probe True
+
+    execs = []
+    monkeypatch.setattr(preflight, "_probe_ok", fake_probe)
+    monkeypatch.setattr(preflight.os, "execv", lambda *a: execs.append(a))
+    out = preflight.preflight_compile_mode(
+        remaining_fn=lambda: 123.7, deadline_env_var="AF2TPU_BENCH_DEADLINE"
+    )
+    assert execs, "expected re-exec"
+    assert os.environ["PALLAS_AXON_REMOTE_COMPILE"] == "0"
+    assert os.environ["AF2TPU_PREFLIGHT_CLIENT_OK"] == "1"
+    assert os.environ["AF2TPU_BENCH_DEADLINE"] == "123"
+    del calls, out
+
+
+def test_bench_cold_cache_extension(monkeypatch, tmp_path):
+    import bench
+
+    cache = tmp_path / "xla_cache"
+    cache.mkdir()
+    monkeypatch.setenv("AF2TPU_COMPILE_CACHE", str(cache))
+    # healthy probe + empty cache -> extension
+    assert bench._cold_cache_deadline_extension("remote_ok") > 0
+    # a re-exec'd client-mode process knows via the env marker
+    monkeypatch.setenv("AF2TPU_PREFLIGHT_CLIENT_OK", "1")
+    assert bench._cold_cache_deadline_extension("skipped") > 0
+    monkeypatch.delenv("AF2TPU_PREFLIGHT_CLIENT_OK")
+    # no liveness evidence -> no extension (the deadline still guards hangs)
+    assert bench._cold_cache_deadline_extension("skipped") == 0
+    assert bench._cold_cache_deadline_extension("both_dead") == 0
+    # warm cache -> no extension
+    (cache / "serialized_exe.bin").write_bytes(b"x")
+    assert bench._cold_cache_deadline_extension("remote_ok") == 0

@@ -80,6 +80,55 @@ def test_tiny_init_matches_full_init_end2end():
     _assert_identical(full, tiny)
 
 
+def test_tiny_init_matches_full_init_templates():
+    # bench_suite config_4 inits at tiny template shapes inline; this pins
+    # the invariant that run relies on: the template embedder (with and
+    # without the SE(3) sidechain colorer) has no input-shape-dependent
+    # params or rng draws, so tiny-shape init is bit-identical (ADVICE r2)
+    import jax.numpy as jnp
+
+    from alphafold2_tpu.models import Alphafold2
+
+    crop, msa_d, T, tn, tT = 24, 3, 3, 12, 2
+    for use_se3 in (False, True):
+        model = Alphafold2(
+            dim=32, depth=1, heads=2, dim_head=16, max_seq_len=64,
+            msa_tie_row_attn=True, template_attn_depth=1,
+            use_se3_template_embedder=use_se3,
+        )
+        k = jax.random.key(7)
+        seq = jax.random.randint(jax.random.fold_in(k, 1), (1, crop), 0, 21)
+        msa = jax.random.randint(
+            jax.random.fold_in(k, 2), (1, msa_d, crop), 0, 21
+        )
+        t_seq = jax.random.randint(
+            jax.random.fold_in(k, 3), (1, T, crop), 0, 21
+        )
+        t_coors = jax.random.normal(
+            jax.random.fold_in(k, 4), (1, T, crop, 3)
+        ) * 10
+        full = model.init(
+            k, seq, msa,
+            mask=jnp.ones((1, crop), bool),
+            msa_mask=jnp.ones((1, msa_d, crop), bool),
+            templates_seq=t_seq, templates_coors=t_coors,
+            templates_mask=jnp.ones((1, T, crop), bool),
+        )
+        tiny = model.init(
+            k, seq[:, :tn], msa[:, :2, :tn],
+            mask=jnp.ones((1, tn), bool),
+            msa_mask=jnp.ones((1, 2, tn), bool),
+            templates_seq=t_seq[:, :tT, :tn],
+            templates_coors=t_coors[:, :tT, :tn],
+            templates_mask=jnp.ones((1, tT, tn), bool),
+        )
+        lf, lt = jax.tree.leaves(full), jax.tree.leaves(tiny)
+        assert len(lf) == len(lt), f"use_se3={use_se3}"
+        assert all(np.array_equal(a, b) for a, b in zip(lf, lt)), (
+            f"use_se3={use_se3}"
+        )
+
+
 def test_tiny_batch_like_shapes():
     batch = {
         "seq": np.zeros((2, 64), np.int32),
