@@ -50,12 +50,15 @@ def user_cache_dir() -> str:
     A pre-existing directory is validated: it must belong to this uid
     (anything else is refused — a directory planted by another user could
     feed poisoned serialized executables) and is tightened to 0700 if a
-    prior process left it group/other-accessible."""
-    root = _os.path.join(
-        _os.path.expanduser("~") if _os.path.expanduser("~") != "~"
-        else "/tmp/af2tpu_u%d" % _os.getuid(),
-        ".cache", "af2tpu",
-    )
+    prior process left it group/other-accessible. The HOME-less fallback
+    is a SINGLE component directly under /tmp: /tmp's sticky bit stops
+    other users renaming/replacing it, which a nested path (whose
+    intermediate parents an attacker could pre-create) would not."""
+    home = _os.path.expanduser("~")
+    if home != "~":
+        root = _os.path.join(home, ".cache", "af2tpu")
+    else:
+        root = "/tmp/af2tpu_u%d" % _os.getuid()
     _os.makedirs(root, mode=0o700, exist_ok=True)
     st = _os.stat(root)
     if st.st_uid != _os.getuid():

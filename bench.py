@@ -292,8 +292,8 @@ def _cold_cache_deadline_extension(preflight_status: str) -> int:
         cold = not cache or not any(
             f for f in os.listdir(cache) if not f.startswith(".")
         )
-    except OSError:
-        cold = True
+    except (OSError, RuntimeError):  # unreadable/foreign-owned dir: the
+        cold = True  # record machinery must survive (cache is optional)
     if not cold:
         return 0
     # the extension must keep the watchdog's ABSOLUTE fire time under the

@@ -69,6 +69,18 @@ def main() -> int:
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--dim-head", type=int, default=16)
     ap.add_argument("--crop", type=int, default=128)
+    # MSA stream (default off = the round-2 seq-only protocol): depth > 1
+    # feeds the reference model a real MSA stream; shards without stored
+    # alignments get the same seeded mutation-synthesized MSA as the jax
+    # side (data/pipeline.py _fill_msa), so both frameworks see identical
+    # arrays. --tie-rows enables the reference's tied-row attention
+    # (alphafold2.py:141-151); crop must then not exceed the shortest
+    # chain (its tied path forbids padded positions).
+    ap.add_argument("--msa-depth", type=int, default=1)
+    ap.add_argument("--msa-len", type=int, default=0)  # 0 = crop
+    ap.add_argument("--tie-rows", action="store_true")
+    # evaluate on a second shard dir of chains NEVER seen in training
+    ap.add_argument("--holdout-dir", default=None)
     ap.add_argument("--batch-size", type=int, default=1)
     ap.add_argument("--lr", type=float, default=3e-4)  # train_pre.py:18
     ap.add_argument("--accum", type=int, default=1)
@@ -91,27 +103,38 @@ def main() -> int:
     from alphafold2_tpu.utils.structure import get_bucketed_distance_matrix
 
     torch.manual_seed(args.seed)
-    data_cfg = DataConfig(
-        source="npz", data_dir=args.data_dir, crop_len=args.crop,
-        batch_size=args.batch_size, msa_depth=1, msa_len=args.crop,
-        min_len_filter=16, max_len_filter=10_000,
-    )
+    msa_len = args.msa_len or args.crop
+    use_msa = args.msa_depth > 1
+
+    def make_data_cfg(data_dir):
+        return DataConfig(
+            source="npz", data_dir=data_dir, crop_len=args.crop,
+            batch_size=args.batch_size, msa_depth=args.msa_depth,
+            msa_len=msa_len, min_len_filter=16, max_len_filter=10_000,
+        )
+
+    data_cfg = make_data_cfg(args.data_dir)
 
     model = Alphafold2(
         dim=args.dim, depth=args.depth, heads=args.heads,
         dim_head=args.dim_head, max_seq_len=args.crop * 2,
+        msa_tie_row_attn=args.tie_rows,
     )
     optim = torch.optim.Adam(model.parameters(), lr=args.lr)
 
-    def batches(seed):
-        for batch in NpzShardDataset(data_cfg, seed=seed):
+    def batches(seed, cfg=None):
+        for batch in NpzShardDataset(cfg or data_cfg, seed=seed):
             seq = torch.from_numpy(batch["seq"]).long()
             mask = torch.from_numpy(batch["mask"]).bool()
+            kw = {"mask": mask}
+            if use_msa:
+                kw["msa"] = torch.from_numpy(batch["msa"]).long()
+                kw["msa_mask"] = torch.from_numpy(batch["msa_mask"]).bool()
             # identical labels to train_pre.py: jnp bucketing, -100 ignore
             labels_np = np.asarray(
                 get_bucketed_distance_matrix(batch["coords"], batch["mask"])
             )
-            yield seq, mask, torch.from_numpy(labels_np).long(), batch
+            yield seq, kw, torch.from_numpy(labels_np).long(), batch
 
     t0 = time.time()
     stream = batches(args.seed)
@@ -120,8 +143,8 @@ def main() -> int:
     for step in range(args.steps):
         optim.zero_grad()
         for _ in range(args.accum):
-            seq, mask, labels, _ = next(stream)
-            logits = model(seq, mask=mask)
+            seq, kw, labels, _ = next(stream)
+            logits = model(seq, **kw)
             ce = F.cross_entropy(
                 logits.reshape(-1, logits.shape[-1]), labels.reshape(-1),
                 ignore_index=-100,
@@ -137,20 +160,25 @@ def main() -> int:
             )
 
     model.eval()
-    lddts, ces = [], []
-    eval_stream = batches(args.eval_seed)
-    with torch.no_grad():
-        for _ in range(args.eval_batches):
-            seq, mask, labels, batch = next(eval_stream)
-            logits = model(seq, mask=mask)
-            ces.append(float(F.cross_entropy(
-                logits.reshape(-1, logits.shape[-1]), labels.reshape(-1),
-                ignore_index=-100,
-            )))
-            dl = distogram_lddt(
-                logits.numpy(), batch["coords"], mask=batch["mask"]
-            )
-            lddts.append(float(np.mean(np.asarray(dl))))
+
+    def eval_stream_metrics(cfg, seed):
+        lddts, ces = [], []
+        stream = batches(seed, cfg)
+        with torch.no_grad():
+            for _ in range(args.eval_batches):
+                seq, kw, labels, batch = next(stream)
+                logits = model(seq, **kw)
+                ces.append(float(F.cross_entropy(
+                    logits.reshape(-1, logits.shape[-1]), labels.reshape(-1),
+                    ignore_index=-100,
+                )))
+                dl = distogram_lddt(
+                    logits.numpy(), batch["coords"], mask=batch["mask"]
+                )
+                lddts.append(float(np.mean(np.asarray(dl))))
+        return float(np.mean(ces)), float(np.mean(lddts))
+
+    eval_ce, eval_lddt = eval_stream_metrics(data_cfg, args.eval_seed)
 
     record = {
         "baseline": "pytorch-reference",
@@ -159,12 +187,20 @@ def main() -> int:
             "dim": args.dim, "depth": args.depth, "heads": args.heads,
             "dim_head": args.dim_head, "crop": args.crop,
             "batch": args.batch_size, "lr": args.lr, "accum": args.accum,
+            "msa_depth": args.msa_depth, "msa_len": msa_len,
+            "tie_rows": args.tie_rows, "seed": args.seed,
         },
         "final_train_ce": round(step_ce, 4),
-        "eval_ce": round(float(np.mean(ces)), 4),
-        "distogram_lddt": round(float(np.mean(lddts)), 4),
+        "eval_ce": round(eval_ce, 4),
+        "distogram_lddt": round(eval_lddt, 4),
         "seconds": round(time.time() - t0, 1),
     }
+    if args.holdout_dir:
+        hce, hdl = eval_stream_metrics(
+            make_data_cfg(args.holdout_dir), args.eval_seed
+        )
+        record["holdout_eval_ce"] = round(hce, 4)
+        record["holdout_distogram_lddt"] = round(hdl, 4)
     print(json.dumps(record))
     return 0
 
