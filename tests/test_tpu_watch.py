@@ -1,0 +1,84 @@
+"""scripts/tpu_watch.sh — the stage accounting the watcher relies on.
+
+The watcher's hardcoded stage-order list must track tpu_session.STAGES
+(importing tpu_session from the shell loop would pay a jax import per
+poll cycle, so the list is duplicated and pinned here instead), and its
+remaining-stages helper must behave for fresh/partial/complete session
+files.
+"""
+
+import json
+import os
+import re
+import subprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WATCH = os.path.join(REPO, "scripts", "tpu_watch.sh")
+
+SESSION_STAGES = [
+    "bench", "baseline", "pallas", "profile", "bisect",
+    "train_real", "capacity", "suite",
+]
+
+
+def _watch_order():
+    src = open(WATCH).read()
+    m = re.search(r"order = \[(.*?)\]", src, re.S)
+    assert m, "stage order list not found in tpu_watch.sh"
+    return re.findall(r'"(\w+)"', m.group(1))
+
+
+def test_watch_order_matches_session_stages():
+    # parse tpu_session.py's STAGES dict literally (no import: module-level
+    # code configures jax) and compare both against the pinned list
+    src = open(os.path.join(REPO, "scripts", "tpu_session.py")).read()
+    m = re.search(r"STAGES = \{(.*?)\}", src, re.S)
+    assert m, "STAGES dict not found in tpu_session.py"
+    session = re.findall(r'"(\w+)":', m.group(1))
+    assert session == SESSION_STAGES
+    assert _watch_order() == SESSION_STAGES
+
+
+def _remaining(tmp_path, session: dict | None, requested: str = ""):
+    """Run the watcher's embedded accounting python exactly as the shell
+    does (extracted heredoc body)."""
+    src = open(WATCH).read()
+    m = re.search(r"<<'PY'.*?\n(.*?)\nPY\n", src, re.S)
+    assert m, "accounting heredoc not found"
+    out_path = tmp_path / "TPU_SESSION.json"
+    if session is not None:
+        out_path.write_text(json.dumps(session))
+    r = subprocess.run(
+        ["python", "-", str(out_path), requested],
+        input=m.group(1), capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    return r.stdout.strip().split()
+
+
+def test_remaining_all_when_no_file(tmp_path):
+    assert _remaining(tmp_path, None) == SESSION_STAGES
+
+
+def test_remaining_skips_green_stages(tmp_path):
+    session = {"stages": {s: {"ok": True} for s in SESSION_STAGES[:4]}}
+    assert _remaining(tmp_path, session) == SESSION_STAGES[4:]
+
+
+def test_remaining_empty_when_all_green(tmp_path):
+    session = {"stages": {s: {"ok": True} for s in SESSION_STAGES}}
+    assert _remaining(tmp_path, session) == []
+
+
+def test_bench_rides_with_baseline(tmp_path):
+    # baseline consumes its own session's bench result: owed baseline
+    # must pull bench back in even when bench is already green
+    session = {"stages": {s: {"ok": True} for s in SESSION_STAGES
+                          if s != "baseline"}}
+    assert _remaining(tmp_path, session) == ["bench", "baseline"]
+
+
+def test_requested_restricts(tmp_path):
+    session = {"stages": {"bench": {"ok": True}}}
+    assert _remaining(tmp_path, session, "bench pallas") == ["pallas"]
+    assert _remaining(tmp_path, session, "bench") == []
