@@ -23,6 +23,14 @@ PROBE='import sys; from alphafold2_tpu.preflight import _probe_ok; sys.exit(0 if
 CYCLES=${AF2TPU_WATCH_CYCLES:-60}
 SLEEP=${AF2TPU_WATCH_SLEEP:-360}
 SESSION_OUT=${AF2TPU_SESSION_OUT:-TPU_SESSION.json}
+# every probe/session line also lands in a repo file: when no healthy
+# window opens all round, the probe log IS the round's perf artifact
+WATCHLOG=${AF2TPU_WATCH_LOG:-TUNNEL_PROBES.log}
+
+log() {
+  echo "$@"
+  echo "$@" >> "$WATCHLOG"
+}
 
 REQUESTED=""
 FLAGS=()
@@ -40,7 +48,7 @@ done
 if [ -f "$SESSION_OUT" ] && [ "${AF2TPU_WATCH_KEEP_SESSION:-0}" != "1" ]; then
   prev="${SESSION_OUT%.json}_prev_$(date +%Y%m%d_%H%M%S).json"
   mv "$SESSION_OUT" "$prev"
-  echo "[watch] archived pre-existing $SESSION_OUT -> $prev"
+  log "[watch] archived pre-existing $SESSION_OUT -> $prev"
 fi
 
 remaining_stages() {
@@ -73,11 +81,11 @@ check_done() {
   REMAINING=$(remaining_stages)
   case "$REMAINING" in
     *ERROR*)
-      echo "[watch] stage accounting failed; treating all stages as owed"
+      log "[watch] stage accounting failed; treating all stages as owed"
       REMAINING="${REQUESTED:-bench baseline pallas profile bisect train_real capacity suite}"
       return 1 ;;
     "")
-      echo "[watch] all session stages green in $SESSION_OUT; done"
+      log "[watch] all session stages green in $SESSION_OUT; done"
       return 0 ;;
   esac
   return 1
@@ -85,7 +93,7 @@ check_done() {
 
 for i in $(seq 1 "$CYCLES"); do
   check_done && exit 0
-  echo "[watch] probe $i/$CYCLES $(date +%H:%M:%S) (owed: $REMAINING)"
+  log "[watch] probe $i/$CYCLES $(date +%H:%M:%S) (owed: $REMAINING)"
   ok=""
   if timeout 300 python -c "$PROBE" >/dev/null 2>&1; then
     ok="remote"
@@ -93,15 +101,15 @@ for i in $(seq 1 "$CYCLES"); do
     ok="client"
   fi
   if [ -n "$ok" ]; then
-    echo "[watch] tunnel healthy ($ok-compile) at $(date +%H:%M:%S); launching tpu_session $REMAINING"
+    log "[watch] tunnel healthy ($ok-compile) at $(date +%H:%M:%S); launching tpu_session $REMAINING"
     AF2TPU_SESSION_DEADLINE=${AF2TPU_WATCH_SESSION_DEADLINE:-9000} \
       AF2TPU_SESSION_RESUME=1 \
       AF2TPU_REAL_PDB_DIR=${AF2TPU_REAL_PDB_DIR:-/root/reference/notebooks/data} \
       python scripts/tpu_session.py $REMAINING ${FLAGS[@]+"${FLAGS[@]}"}
-    echo "[watch] session rc=$?"
+    log "[watch] session rc=$?"
     check_done && exit 0
   fi
   sleep "$SLEEP"
 done
-echo "[watch] cycle budget spent; owed stages: $(remaining_stages)"
+log "[watch] cycle budget spent; owed stages: $(remaining_stages)"
 exit 1
