@@ -44,6 +44,10 @@ def main() -> int:
     ap.add_argument("--msa-depth", type=int, default=1)
     ap.add_argument("--msa-len", type=int, default=0)  # 0 = crop
     ap.add_argument("--tie-rows", action="store_true")
+    # inversion-based O(1)-activation-memory trunk engine (beyond-reference
+    # at this scale: the reference's reversible mode exists but its repo
+    # never trained it on real data)
+    ap.add_argument("--reversible", action="store_true")
     ap.add_argument("--bf16", action="store_true")  # default f32 = torch CPU
     ap.add_argument("--holdout-dir", default=None)
     ap.add_argument("--batch-size", type=int, default=1)
@@ -85,6 +89,7 @@ def main() -> int:
             dim=args.dim, depth=args.depth, heads=args.heads,
             dim_head=args.dim_head, max_seq_len=args.crop * 2,
             msa_tie_row_attn=args.tie_rows, bfloat16=args.bf16,
+            reversible=args.reversible,
         ),
         data=data_cfg,
     )
@@ -183,6 +188,7 @@ def main() -> int:
             "msa_depth": args.msa_depth, "msa_len": msa_len,
             "tie_rows": args.tie_rows, "seed": args.seed,
             "dtype": "bf16" if args.bf16 else "f32",
+            "engine": "reversible" if args.reversible else "default",
         },
         "final_train_ce": round(step_ce, 4),
         "eval_ce": round(eval_ce, 4),
