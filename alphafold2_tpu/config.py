@@ -49,6 +49,10 @@ class ModelConfig:
     scan_layers: bool = False
     template_attn_depth: int = 2
     bfloat16: bool = True  # compute dtype on TPU
+    # parameter init distributions: "flax" (lecun-normal Dense, N(0,1/dim)
+    # embeddings) | "torch" (the reference's module defaults — see
+    # models/init.py; incompatible with scan_layers' stacked params)
+    init_scheme: str = "flax"
 
 
 @dataclass

@@ -117,6 +117,19 @@ def build_optimizer(cfg: Config) -> optax.GradientTransformation:
 
 
 def init_state(cfg: Config, model: Alphafold2, sample_batch: dict) -> TrainState:
+    # validate the init scheme BEFORE the (expensive) model.init trace
+    if cfg.model.init_scheme == "torch":
+        if cfg.model.scan_layers or cfg.model.reversible:
+            raise ValueError(
+                "init_scheme='torch' is incompatible with scan_layers and "
+                "the reversible engine: their depth-stacked params would "
+                "corrupt the fan_in computation (models/init.py)"
+            )
+    elif cfg.model.init_scheme != "flax":
+        raise ValueError(
+            f"unknown init_scheme {cfg.model.init_scheme!r}; "
+            "expected 'flax' or 'torch'"
+        )
     rng = jax.random.key(cfg.train.seed)
 
     def opt(key):
@@ -131,6 +144,11 @@ def init_state(cfg: Config, model: Alphafold2, sample_batch: dict) -> TrainState
         msa_mask=opt("msa_mask"),
         embedds=opt("embedds"),
     )
+    if cfg.model.init_scheme == "torch":
+        # re-draw under the reference's torch module defaults (models/init.py)
+        from alphafold2_tpu.models.init import torch_match_reinit
+
+        params = torch_match_reinit(params, rng)
     return TrainState.create(
         apply_fn=model.apply,
         params=params,

@@ -48,6 +48,9 @@ def main() -> int:
     # at this scale: the reference's reversible mode exists but its repo
     # never trained it on real data)
     ap.add_argument("--reversible", action="store_true")
+    # re-draw params under the reference's torch module defaults
+    # (models/init.py) — isolates init distributions in the head-to-head
+    ap.add_argument("--torch-init", action="store_true")
     ap.add_argument("--bf16", action="store_true")  # default f32 = torch CPU
     ap.add_argument("--holdout-dir", default=None)
     ap.add_argument("--batch-size", type=int, default=1)
@@ -72,6 +75,13 @@ def main() -> int:
     )
     from alphafold2_tpu.utils import distogram_lddt
     from alphafold2_tpu.utils.structure import get_bucketed_distance_matrix
+
+    if args.torch_init and args.reversible:
+        ap.error(
+            "--torch-init is incompatible with --reversible: the reversible "
+            "trunk's depth-stacked params would corrupt the fan_in "
+            "computation (models/init.py)"
+        )
 
     msa_len = args.msa_len or args.crop
     use_msa = args.msa_depth > 1
@@ -114,6 +124,10 @@ def main() -> int:
         mask=jnp.asarray(tiny["mask"]),
         msa_mask=jnp.asarray(tiny["msa_mask"]) if use_msa else None,
     )
+    if args.torch_init:
+        from alphafold2_tpu.models.init import torch_match_reinit
+
+        params = torch_match_reinit(params, jax.random.key(args.seed))
     # plain Adam, exactly torch.optim.Adam's defaults (betas 0.9/0.999,
     # eps 1e-8) — NOT the production warmup-cosine/clip/adamw of
     # train.loop.build_optimizer, which torch's side doesn't have
@@ -189,6 +203,7 @@ def main() -> int:
             "tie_rows": args.tie_rows, "seed": args.seed,
             "dtype": "bf16" if args.bf16 else "f32",
             "engine": "reversible" if args.reversible else "default",
+            "init": "torch" if args.torch_init else "flax",
         },
         "final_train_ce": round(step_ce, 4),
         "eval_ce": round(eval_ce, 4),
