@@ -60,6 +60,7 @@ _INFRA_KNOBS = {
     "AF2TPU_BENCH_ATTEMPTS", "AF2TPU_BENCH_DEADLINE",
     "AF2TPU_BENCH_COLD_EXTRA", "AF2TPU_BENCH_DRIVER_BUDGET",
     "AF2TPU_BENCH_EPOCH0",  # wall-clock anchor set by __main__ itself
+    "AF2TPU_BENCH_FIRST_LIGHT",  # fallback policy, not a config size
 }
 
 
@@ -73,16 +74,39 @@ def config_overridden() -> bool:
     )
 
 
-def _metric() -> str:
+def _metric(crop=None, msa_depth=None, msa_len=None, dim=None, depth=None,
+            batch=None) -> str:
     """One label for success and failure records — the driver correlates
     records for the same config by this string."""
     return (
-        f"residue-pairs/sec/chip crop={CROP} msa={MSA_DEPTH}x{MSA_LEN} "
-        f"dim={DIM} depth={DEPTH} batch={BATCH} fwd+bwd+opt"
+        f"residue-pairs/sec/chip crop={crop or CROP} "
+        f"msa={msa_depth or MSA_DEPTH}x{msa_len or MSA_LEN} "
+        f"dim={dim or DIM} depth={depth or DEPTH} "
+        f"batch={batch or BATCH} fwd+bwd+opt"
     )
 
 
-def main():
+# which phase of the measurement the process is in — the watchdog's failure
+# record reports it, so "backend init never returned" is distinguishable
+# from "compile/run exceeded deadline" (VERDICT r3 #1b)
+_PHASE = {"name": "startup"}
+
+# a completed smaller-config measurement held as the fallback result: if
+# the flagship attempt then hangs or exceeds the deadline, the watchdog
+# emits THIS instead of a value-0.0 failure record, so any healthy tunnel
+# window yields a nonzero number (VERDICT r3 #1a)
+_FIRST_LIGHT = {"record": None}
+
+
+def main(overrides: dict | None = None, emit: bool = True):
+    o = overrides or {}
+    crop = o.get("crop", CROP)
+    msa_depth = o.get("msa_depth", MSA_DEPTH)
+    msa_len = o.get("msa_len", MSA_LEN)
+    dim = o.get("dim", DIM)
+    depth = o.get("depth", DEPTH)
+    batch = o.get("batch", BATCH)
+    phase_prefix = "first_light:" if overrides else ""
     from alphafold2_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
     from alphafold2_tpu.data.pipeline import SyntheticDataset
     from alphafold2_tpu.train.loop import (
@@ -94,23 +118,25 @@ def main():
 
     cfg = Config(
         model=ModelConfig(
-            dim=DIM, depth=DEPTH, heads=8, dim_head=64, max_seq_len=CROP * 2,
+            dim=dim, depth=depth, heads=8, dim_head=64, max_seq_len=crop * 2,
             msa_tie_row_attn=True, bfloat16=True,
         ),
         data=DataConfig(
-            crop_len=CROP, msa_depth=MSA_DEPTH, msa_len=MSA_LEN, batch_size=BATCH,
-            min_len_filter=CROP,  # full-length crops for a stable FLOP count
+            crop_len=crop, msa_depth=msa_depth, msa_len=msa_len,
+            batch_size=batch,
+            min_len_filter=crop,  # full-length crops for a stable FLOP count
         ),
         train=TrainConfig(gradient_accumulate_every=1, warmup_steps=10),
     )
 
-    batch = next(iter(SyntheticDataset(cfg.data, seed=0)))
+    _PHASE["name"] = phase_prefix + "backend_init"
+    data_batch = next(iter(SyntheticDataset(cfg.data, seed=0)))
     model = build_model(cfg)
     # init at tiny slices of the batch: identical params, none of the
     # full-size init compile (train.loop.tiny_init_state)
-    state = tiny_init_state(cfg, model, batch)
+    state = tiny_init_state(cfg, model, data_batch)
     raw_step = make_train_step(model, mesh=None, jit=False)
-    dev_batch = device_put_batch(batch)
+    dev_batch = device_put_batch(data_batch)
     rng = jax.random.key(0)
 
     # chain INGRAPH steps inside one program: per-dispatch host/tunnel
@@ -127,27 +153,33 @@ def main():
 
     # AOT-compile once: the same executable serves warmup, the timed loop,
     # and the FLOPs count for MFU (no second trace/compile)
+    _PHASE["name"] = phase_prefix + "trace_compile"
     compiled = jax.jit(multi_step, donate_argnums=0).lower(
         state, dev_batch, rng
     ).compile()
 
+    _PHASE["name"] = phase_prefix + "warmup_run"
     for i in range(WARMUP):
         rng, r = jax.random.split(rng)
         state, loss = compiled(state, dev_batch, r)
     jax.block_until_ready(state.params)  # WARMUP=0 safe
 
+    _PHASE["name"] = phase_prefix + "timed_run"
     t0 = time.perf_counter()
     for i in range(ITERS):
         rng, r = jax.random.split(rng)
         state, loss = compiled(state, dev_batch, r)
     jax.block_until_ready(loss)
     dt = (time.perf_counter() - t0) / (ITERS * INGRAPH)
+    _PHASE["name"] = phase_prefix + "record"
 
-    pairs_per_sec = BATCH * CROP * CROP / dt
+    pairs_per_sec = batch * crop * crop / dt
     mfu = _estimate_mfu(compiled, dt * INGRAPH)
 
     baseline_path = os.path.join(os.path.dirname(__file__), "bench_baseline.json")
-    overridden = config_overridden()
+    # env-size overrides AND in-process first-light overrides are both
+    # non-flagship configs: never compared against the committed baseline
+    overridden = config_overridden() or bool(overrides)
     vs_baseline = 1.0
     compared = False
     if os.path.exists(baseline_path) and not overridden:
@@ -172,7 +204,8 @@ def main():
             )
 
     record = {
-        "metric": _metric(),
+        "metric": _metric(crop=crop, msa_depth=msa_depth, msa_len=msa_len,
+                          dim=dim, depth=depth, batch=batch),
         "value": round(pairs_per_sec, 1),
         "unit": "pairs/sec",
         "vs_baseline": round(vs_baseline, 3),
@@ -184,7 +217,15 @@ def main():
     }
     if mfu is not None:
         record["mfu"] = round(mfu, 4)
-    _emit(record)
+    if not overrides and _FIRST_LIGHT["record"] is not None:
+        # evidence trail: the flagship line carries its first-light result
+        fl = _FIRST_LIGHT["record"]
+        record["first_light"] = {
+            "metric": fl["metric"], "value": fl["value"],
+            **({"mfu": fl["mfu"]} if "mfu" in fl else {}),
+        }
+    if emit:
+        _emit(record)
     return record
 
 
@@ -230,7 +271,40 @@ def _failure_record(msg: str) -> dict:
         "vs_baseline": 0.0,
         "vs_baseline_valid": False,
         "error": msg,
+        "phase": _PHASE["name"],
     }
+
+
+def _phase_failure_msg() -> str:
+    """Deadline message that says WHICH phase died — 'backend init never
+    returned' is a tunnel hang, 'trace_compile' is a too-slow/hung compile,
+    'warmup/timed' is a run that is genuinely too slow for the budget."""
+    phase = _PHASE["name"]
+    if "backend_init" in phase:
+        detail = "backend init never returned (tunnel hang)"
+    elif "trace_compile" in phase:
+        detail = "compile exceeded the remaining budget"
+    elif "run" in phase:
+        detail = "compiled run too slow for the remaining budget"
+    else:
+        detail = "died before touching the backend"
+    return (
+        f"deadline {DEADLINE}s exceeded during phase '{phase}': {detail}; "
+        "raise AF2TPU_BENCH_DEADLINE for bigger configs"
+    )
+
+
+def _emit_failure(msg: str) -> None:
+    """On flagship failure, prefer the completed first-light measurement
+    (a real nonzero number at a smaller config) over a value-0.0 record."""
+    rec = _FIRST_LIGHT["record"]
+    if rec is not None:
+        rec = dict(rec)
+        rec["fallback"] = True
+        rec["flagship_error"] = msg
+        _emit(rec)
+    else:
+        _emit(_failure_record(msg))
 
 
 import threading
@@ -341,10 +415,7 @@ if __name__ == "__main__":
             if remaining <= 0:
                 break
             time.sleep(min(30.0, remaining))
-        _emit(_failure_record(
-            f"deadline {DEADLINE}s exceeded (backend init hang or run too "
-            "slow); raise AF2TPU_BENCH_DEADLINE for bigger configs"
-        ))
+        _emit_failure(_phase_failure_msg())
         os._exit(0)
 
     # watchdog FIRST: the preflight probes (2 x 240s subprocesses) must not
@@ -354,6 +425,33 @@ if __name__ == "__main__":
 
     preflight_status = _preflight_compile_mode()
     DEADLINE += _cold_cache_deadline_extension(preflight_status)
+
+    # First light (VERDICT r3 #1a): measure a smaller config BEFORE the
+    # flagship so a healthy-but-slow window still yields a nonzero record
+    # — if the flagship compile then eats the rest of the budget, the
+    # watchdog emits this result instead of a 0.0 failure. Skipped when the
+    # operator already overrode the config (their override IS the config
+    # under test) or the watchdog is disabled (nothing can eat the budget).
+    if (
+        os.environ.get("AF2TPU_BENCH_FIRST_LIGHT", "1") != "0"
+        and not config_overridden()
+        and DEADLINE > 0
+    ):
+        try:
+            rec = main(
+                overrides={"crop": 128, "msa_len": 128}, emit=False
+            )
+            _FIRST_LIGHT["record"] = rec
+            print(
+                f"first light: {rec['value']} pairs/sec at crop 128 "
+                f"(mfu={rec.get('mfu')}); attempting flagship",
+                file=sys.stderr,
+            )
+        except Exception as e:
+            # a dead backend fails identically at the flagship attempt
+            # below, which owns the retry/record logic
+            print(f"first-light attempt failed ({type(e).__name__}: {e}); "
+                  "proceeding to flagship", file=sys.stderr)
 
     # the tunneled-TPU backend can fail transiently at INIT; retry a few
     # times before giving up so a single flaky window doesn't lose the run.
@@ -367,7 +465,7 @@ if __name__ == "__main__":
             break
         except RuntimeError as e:
             if "Unable to initialize backend" not in str(e):
-                _emit(_failure_record(f"{type(e).__name__}: {e}"))
+                _emit_failure(f"{type(e).__name__}: {e}")
                 raise
             remaining = (
                 DEADLINE - (time.monotonic() - _T0)
@@ -376,14 +474,14 @@ if __name__ == "__main__":
             # a retry only helps if there is still time for the 60s backoff
             # plus a realistic init (~4-5 min through the tunnel)
             if i == attempts - 1 or remaining < 360:
-                _emit(_failure_record(
+                _emit_failure(
                     f"backend init failed ({i + 1} attempt(s), "
                     f"{remaining:.0f}s of {DEADLINE}s budget left): {e}"
-                ))
+                )
                 sys.exit(0)
             print(f"backend init unavailable (attempt {i + 1}/{attempts}); "
                   "retrying in 60s", file=sys.stderr)
             time.sleep(60)
         except Exception as e:  # non-RuntimeError: still leave a record
-            _emit(_failure_record(f"{type(e).__name__}: {e}"))
+            _emit_failure(f"{type(e).__name__}: {e}")
             raise

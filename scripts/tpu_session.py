@@ -107,6 +107,20 @@ def _stage(name, fn):
     _flush()
 
 
+def stage_first_light():
+    """Smaller-config (crop 128) measurement FIRST: any healthy window
+    yields a nonzero TPU number (+ mfu) even if the flagship compile later
+    eats the stage budget (VERDICT r3 #1a). Cheap when the cache is warm;
+    skipped once the flagship bench is green in this session file."""
+    import bench
+
+    if RESULTS["stages"].get("bench", {}).get("ok"):
+        return "skipped (flagship bench already green)"
+    rec = bench.main(overrides={"crop": 128, "msa_len": 128}, emit=False)
+    RESULTS["device"] = __import__("jax").devices()[0].device_kind
+    return rec
+
+
 def stage_bench():
     import bench
 
@@ -475,6 +489,7 @@ def stage_bisect():
 # rest of the session's budget with it, so the big-compile stages (suite's
 # depth-12 configs, the capacity sweep) run last
 STAGES = {
+    "first_light": stage_first_light,
     "bench": stage_bench,
     "baseline": stage_baseline,
     "pallas": stage_pallas,

@@ -61,7 +61,7 @@ remaining_stages() {
 import json, sys
 # keep in sync with scripts/tpu_session.py STAGES
 # (tests/test_tpu_watch.py asserts the two lists match)
-order = ["bench", "baseline", "pallas", "profile", "bisect",
+order = ["first_light", "bench", "baseline", "pallas", "profile", "bisect",
          "train_real", "capacity", "suite"]
 try:
     with open(sys.argv[1]) as f:
@@ -82,7 +82,7 @@ check_done() {
   case "$REMAINING" in
     *ERROR*)
       log "[watch] stage accounting failed; treating all stages as owed"
-      REMAINING="${REQUESTED:-bench baseline pallas profile bisect train_real capacity suite}"
+      REMAINING="${REQUESTED:-first_light bench baseline pallas profile bisect train_real capacity suite}"
       return 1 ;;
     "")
       log "[watch] all session stages green in $SESSION_OUT; done"

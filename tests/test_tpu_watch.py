@@ -11,12 +11,13 @@ import json
 import os
 import re
 import subprocess
+import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WATCH = os.path.join(REPO, "scripts", "tpu_watch.sh")
 
 SESSION_STAGES = [
-    "bench", "baseline", "pallas", "profile", "bisect",
+    "first_light", "bench", "baseline", "pallas", "profile", "bisect",
     "train_real", "capacity", "suite",
 ]
 
@@ -49,7 +50,7 @@ def _remaining(tmp_path, session: dict | None, requested: str = ""):
     if session is not None:
         out_path.write_text(json.dumps(session))
     r = subprocess.run(
-        ["python", "-", str(out_path), requested],
+        [sys.executable, "-", str(out_path), requested],
         input=m.group(1), capture_output=True, text=True,
     )
     assert r.returncode == 0, r.stderr
