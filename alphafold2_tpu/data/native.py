@@ -100,7 +100,8 @@ def bucketize_distances(
 ) -> np.ndarray:
     """(N, 3) float32 coords + (N,) bool mask -> (N, N) int32 labels."""
     lib = _load()
-    assert lib is not None, "native library not built (make -C native)"
+    if lib is None:
+        raise RuntimeError("native library not built (make -C native)")
     coords = np.ascontiguousarray(coords, np.float32)
     mask_u8 = np.ascontiguousarray(mask, np.uint8)
     n = coords.shape[0]
@@ -116,7 +117,8 @@ def bucketize_distances(
 def synthesize_batch(config: DataConfig, seed: int) -> dict:
     """One-shot native batch synthesis (deterministic by seed)."""
     lib = _load()
-    assert lib is not None, "native library not built (make -C native)"
+    if lib is None:
+        raise RuntimeError("native library not built (make -C native)")
     B, L, M, NM = (
         config.batch_size, config.crop_len, config.msa_depth, config.msa_len,
     )
@@ -164,7 +166,10 @@ class NativeSyntheticLoader:
     def _bind(self, config: DataConfig) -> ctypes.CDLL:
         """Shared init prelude: load the library and stash lib/config."""
         lib = _load()
-        assert lib is not None, "native library not built (make -C native)"
+        if lib is None:
+            raise RuntimeError(
+                "native library not built (make -C native)"
+            )
         self._lib = lib
         self.config = config
         return lib
@@ -281,4 +286,5 @@ class NativeShardLoader(NativeSyntheticLoader):
             constants.DISTOGRAM_BUCKETS, constants.DISTOGRAM_MIN_DIST,
             constants.DISTOGRAM_MAX_DIST, ignore_index,
         )
-        assert self._handle, "af2_real_loader_create failed"
+        if not self._handle:
+            raise RuntimeError("af2_real_loader_create failed")

@@ -176,7 +176,8 @@ def _npz_paths(data_dir: str) -> list:
     import glob
     import os
 
-    assert data_dir, "npz shards need data.data_dir"
+    if not data_dir:
+        raise ValueError("npz shards need data.data_dir")
     paths = sorted(glob.glob(os.path.join(data_dir, "*.npz")))
     if not paths:
         raise FileNotFoundError(f"no .npz shards under {data_dir!r}")

@@ -122,7 +122,8 @@ def make_provider(kind: str, dim: int = constants.NUM_EMBEDDS_TR,
     if kind == "hash":
         return HashProjectionProvider(dim=dim, seed=seed)
     if kind == "precomputed":
-        assert path, "precomputed provider needs data.plm_path"
+        if not path:
+            raise ValueError("precomputed provider needs data.plm_path")
         return PrecomputedProvider(path)
     if kind == "esm":
         return TransformersESMProvider()

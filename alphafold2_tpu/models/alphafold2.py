@@ -222,9 +222,11 @@ class Alphafold2(nn.Module):
 
         # template stream
         if templates_seq is not None:
-            assert templates_coors is not None, (
-                "template residue coordinates must be supplied `templates_coors`"
-            )
+            if templates_coors is None:
+                raise ValueError(
+                    "template residue coordinates must be supplied "
+                    "via `templates_coors`"
+                )
             T = templates_seq.shape[1]
             if templates_dist is None:
                 templates_dist = get_bucketed_distance_matrix(

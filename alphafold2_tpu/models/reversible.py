@@ -263,10 +263,11 @@ class ReversibleTrunk(nn.Module):
 
     @nn.compact
     def __call__(self, x, m, pair_mask=None, msa_mask=None, deterministic=True):
-        assert m is not None, (
-            "ReversibleTrunk requires the MSA stream (reference "
-            "reversible.py:316); use Trunk(remat=True) without one"
-        )
+        if m is None:
+            raise ValueError(
+                "ReversibleTrunk requires the MSA stream (reference "
+                "reversible.py:316); use Trunk(remat=True) without one"
+            )
         # The carried state must stay float32 even under bf16 compute:
         # inversion reconstructs x1 as (x1 + f) - f, and in bf16 that
         # roundoff compounds across the 8 updates x depth steps, silently

@@ -279,7 +279,11 @@ class Trunk(nn.Module):
         sparse_flags = self.sparse_self_attn
         if not isinstance(sparse_flags, (tuple, list)):
             sparse_flags = (sparse_flags,) * self.depth
-        assert len(sparse_flags) == self.depth
+        if len(sparse_flags) != self.depth:
+            raise ValueError(
+                f"sparse_self_attn tuple has {len(sparse_flags)} entries "
+                f"for depth {self.depth}"
+            )
 
         # validate eagerly: a policy name (even a typo) with remat off, or
         # with the reversible engine (which never applies it), would
@@ -302,26 +306,34 @@ class Trunk(nn.Module):
             # is implied and remat is redundant
             from alphafold2_tpu.models.reversible import ReversibleTrunk
 
-            assert len(set(sparse_flags)) <= 1, (
-                "the reversible engine scans one stacked layer; per-layer "
-                f"sparse_self_attn={sparse_flags} needs the python loop"
-            )
-            assert self.context_parallel is None, (
-                "context_parallel is not supported by the reversible engine "
-                "(its cross-attention runs dense per device); use "
-                "remat=True with context_parallel, or reversible without it"
-            )
-            assert not self.msa_row_shard, (
-                "msa_row_shard is not supported by the reversible engine "
-                "(its MSA streams are replicated); use remat=True to "
-                "combine MSA-row sharding with O(1) activation memory"
-            )
-            assert not self.grid_parallel, (
-                "grid_parallel is not supported by the reversible engine "
-                "(its axial passes run dense, so the 2D-sharded pair state "
-                "would be all-gathered and the memory benefit silently "
-                "lost); use remat=True with grid_parallel"
-            )
+            if len(set(sparse_flags)) > 1:
+                raise ValueError(
+                    "the reversible engine scans one stacked layer; "
+                    f"per-layer sparse_self_attn={sparse_flags} needs the "
+                    "python loop"
+                )
+            if self.context_parallel is not None:
+                raise ValueError(
+                    "context_parallel is not supported by the reversible "
+                    "engine (its cross-attention runs dense per device); "
+                    "use remat=True with context_parallel, or reversible "
+                    "without it"
+                )
+            if self.msa_row_shard:
+                raise ValueError(
+                    "msa_row_shard is not supported by the reversible "
+                    "engine (its MSA streams are replicated); use "
+                    "remat=True to combine MSA-row sharding with O(1) "
+                    "activation memory"
+                )
+            if self.grid_parallel:
+                raise ValueError(
+                    "grid_parallel is not supported by the reversible "
+                    "engine (its axial passes run dense, so the 2D-sharded "
+                    "pair state would be all-gathered and the memory "
+                    "benefit silently lost); use remat=True with "
+                    "grid_parallel"
+                )
             return ReversibleTrunk(
                 dim=self.dim,
                 depth=self.depth,
@@ -342,10 +354,12 @@ class Trunk(nn.Module):
               deterministic=deterministic)
 
         if self.scan_layers:
-            assert len(set(sparse_flags)) <= 1, (
-                "scan_layers needs homogeneous layers; per-layer "
-                f"sparse_self_attn={sparse_flags} requires the python loop"
-            )
+            if len(set(sparse_flags)) > 1:
+                raise ValueError(
+                    "scan_layers needs homogeneous layers; per-layer "
+                    f"sparse_self_attn={sparse_flags} requires the python "
+                    "loop"
+                )
             scanned = nn.scan(
                 _ScanBody,
                 variable_axes={"params": 0},

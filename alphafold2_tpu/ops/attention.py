@@ -181,7 +181,10 @@ class Attention(nn.Module):
         k, v = jnp.split(self.to_kv(ctx), 2, axis=-1)
 
         if self.compress_ratio > 1:
-            assert has_context, "KV compression is for cross-attention only"
+            if not has_context:
+                raise ValueError(
+                    "KV compression is for cross-attention only"
+                )
             ratio = self.compress_ratio
             j = k.shape[-2]
             pad = (-j) % ratio
@@ -414,11 +417,12 @@ class AxialAttention(nn.Module):
 
             mesh = active_mesh()
             if mesh is not None and ROW_AXIS_NAME in mesh.axis_names:
-                assert context is None and not self.tie_row_attn, (
-                    "grid_parallel axial attention is self-attention only "
-                    "(no broadcast context, no tied rows — neither occurs "
-                    "on the pair stream)"
-                )
+                if context is not None or self.tie_row_attn:
+                    raise ValueError(
+                        "grid_parallel axial attention is self-attention "
+                        "only (no broadcast context, no tied rows — "
+                        "neither occurs on the pair stream)"
+                    )
                 grid_mesh_active = True
 
         # Grid route: q/kv/out projections stay pointwise on the

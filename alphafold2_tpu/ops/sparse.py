@@ -71,7 +71,11 @@ class BlockSparseConfig:
 
     def layout(self, seq_len: int) -> np.ndarray:
         """(num_blocks, num_blocks) bool — True where a block attends."""
-        assert seq_len % self.block_size == 0, (seq_len, self.block_size)
+        if seq_len % self.block_size != 0:
+            raise ValueError(
+                f"seq_len {seq_len} must be a multiple of block_size "
+                f"{self.block_size}"
+            )
         nb = seq_len // self.block_size
         lay = np.zeros((nb, nb), dtype=bool)
         # local sliding window
@@ -241,9 +245,18 @@ def _block_layout_mask_cls():
                 raise NotImplementedError(f"unsupported mask index {idx!r}")
             r = np.arange(self.shape[0])[idx[0]] // self._bs
             c = np.arange(self.shape[1])[idx[1]] // self._bs
+            # dispatch on the ORIGINAL index types, not the resolved
+            # arrays (ADVICE r3): numpy gives slice-involved indexing
+            # outer-product semantics but array+array element-wise
+            # *paired/broadcast* semantics, and a dense ndarray mask would
+            # honor both — np.ix_ on a resolved integer-array pair would
+            # silently return an outer-product block of the wrong shape
+            # and values.
+            if not isinstance(idx[0], slice) and not isinstance(idx[1], slice):
+                return self._layout[r, c]  # paired/broadcast
             if r.ndim == 1 and c.ndim == 1:
-                return self._layout[np.ix_(r, c)]
-            return self._layout[r, c]
+                return self._layout[np.ix_(r, c)]  # outer product
+            return self._layout[r, c]  # scalar-involved: broadcast
 
         def __eq__(self, other):
             if not isinstance(other, _BlockLayoutMask):
@@ -387,12 +400,13 @@ class SparseAttention(nn.Module):
         h, dh = self.heads, self.dim_head
         n_att = x.shape[attend_axis]
         bs = self.config.block_size
-        assert n_att % bs == 0, (
-            f"grid-sharded sparse attention needs the attended axis "
-            f"({n_att}) to be a multiple of block_size ({bs})"
-        )
-        if self.seq_len is not None:
-            assert n_att <= self.seq_len, (
+        if n_att % bs != 0:
+            raise ValueError(
+                f"grid-sharded sparse attention needs the attended axis "
+                f"({n_att}) to be a multiple of block_size ({bs})"
+            )
+        if self.seq_len is not None and n_att > self.seq_len:
+            raise ValueError(
                 f"attended axis {n_att} exceeds max_seq_len {self.seq_len}"
             )
         layout = self.config.layout(n_att)
@@ -415,13 +429,16 @@ class SparseAttention(nn.Module):
         tie_dim=None,
         deterministic: bool = True,
     ):
-        assert context is None, "sparse attention is self-attention only"
-        assert tie_dim is None, (
-            "sparse attention is not compatible with tying of row attention"
-        )
+        if context is not None:
+            raise ValueError("sparse attention is self-attention only")
+        if tie_dim is not None:
+            raise ValueError(
+                "sparse attention is not compatible with tying of row "
+                "attention"
+            )
         b, n, _ = x.shape
-        if self.seq_len is not None:
-            assert n <= self.seq_len, (
+        if self.seq_len is not None and n > self.seq_len:
+            raise ValueError(
                 f"sequence length {n} exceeds max_seq_len {self.seq_len}"
             )
         h, dh = self.heads, self.dim_head

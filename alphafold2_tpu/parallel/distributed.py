@@ -89,11 +89,13 @@ def pod_mesh(
     """
     total = jax.device_count()
     if n_data == -1:
-        assert total % n_seq == 0, (total, n_seq)
+        if total % n_seq != 0:
+            raise ValueError(
+                f"{total} devices do not divide by sp={n_seq}"
+            )
         n_data = total // n_seq
-    assert n_data * n_seq == total, (
-        f"mesh {n_data}x{n_seq} != {total} devices"
-    )
+    if n_data * n_seq != total:
+        raise ValueError(f"mesh {n_data}x{n_seq} != {total} devices")
     devices = mesh_utils.create_device_mesh(
         (n_data, n_seq), allow_split_physical_axes=allow_split_physical_axes
     )

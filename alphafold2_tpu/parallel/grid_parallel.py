@@ -54,7 +54,10 @@ def make_grid_mesh(
 
     devices = devices if devices is not None else jax.devices()
     n = n_data * n_row * n_col
-    assert n == len(devices), f"mesh {n_data}x{n_row}x{n_col} != {len(devices)}"
+    if n != len(devices):
+        raise ValueError(
+            f"mesh {n_data}x{n_row}x{n_col} != {len(devices)} devices"
+        )
     try:
         from jax.experimental import mesh_utils
 

@@ -109,9 +109,10 @@ def ulysses_attention(
     """All-to-all sequence parallelism (Ulysses): re-shard seq -> heads,
     attend densely over the full sequence locally, re-shard back."""
     sp = lax.axis_size(axis_name)
-    assert q.shape[1] % sp == 0, (
-        f"heads {q.shape[1]} must divide by sp={sp} for ulysses"
-    )
+    if q.shape[1] % sp != 0:
+        raise ValueError(
+            f"heads {q.shape[1]} must divide by sp={sp} for ulysses"
+        )
     # (B, H, n, D) -> (B, H/sp, n*sp, D): split heads across devices,
     # gather the sequence
     def seq_to_heads(t):
@@ -172,8 +173,10 @@ def tied_row_attention(
         return _tied_core(q, k, v, r, None)
     sp = mesh.shape[SEQ_AXIS_NAME]
     dp = mesh.shape.get(DATA_AXIS_NAME, 1)
-    assert r % sp == 0, f"MSA rows {r} must divide by sp={sp}"
-    assert b % dp == 0, f"batch {b} must divide by dp={dp}"
+    if r % sp != 0:
+        raise ValueError(f"MSA rows {r} must divide by sp={sp}")
+    if b % dp != 0:
+        raise ValueError(f"batch {b} must divide by dp={dp}")
     spec = P(DATA_AXIS_NAME, SEQ_AXIS_NAME)
     mapped = shard_map(
         partial(tied_row_attention_sharded, num_rows_global=r),

@@ -44,9 +44,10 @@ def make_mesh(
     devices = devices if devices is not None else jax.devices()
     if n_data is None:
         n_data = len(devices) // n_seq
-    assert n_data * n_seq == len(devices), (
-        f"mesh {n_data}x{n_seq} != {len(devices)} devices"
-    )
+    if n_data * n_seq != len(devices):
+        raise ValueError(
+            f"mesh {n_data}x{n_seq} != {len(devices)} devices"
+        )
     arr = np.asarray(devices).reshape(n_data, n_seq)
     return Mesh(arr, (DATA_AXIS, SEQ_AXIS))
 

@@ -211,7 +211,10 @@ def distogram_lddt(
 def _normalize_pair(A, B, dim_len):
     A = jnp.asarray(A)
     B = jnp.asarray(B)
-    assert A.ndim == B.ndim, "Shapes of A and B must match."
+    if A.ndim != B.ndim:
+        raise ValueError(
+            f"shapes of A ({A.shape}) and B ({B.shape}) must match"
+        )
     A = _expand_to(A, dim_len - A.ndim)
     B = _expand_to(B, dim_len - B.ndim)
     return A, B

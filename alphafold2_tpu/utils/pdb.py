@@ -247,7 +247,10 @@ def replace_coords(s: PDBStructure, coords: np.ndarray) -> PDBStructure:
     coords = np.asarray(coords, np.float32)
     if coords.shape[0] == 3 and coords.shape[-1] != 3:
         coords = coords.T
-    assert coords.shape == s.coords.shape, (coords.shape, s.coords.shape)
+    if coords.shape != s.coords.shape:
+        raise ValueError(
+            f"coords shape {coords.shape} != structure {s.coords.shape}"
+        )
     return dataclasses.replace(s, coords=coords)
 
 
@@ -299,7 +302,11 @@ def backbone_to_pdb(
     ca_only = backbone.ndim == 2
     names = ["CA"] if ca_only else ["N", "CA", "C"]
     per = len(names)
-    assert backbone.size == L * per * 3, (backbone.shape, L, per)
+    if backbone.size != L * per * 3:
+        raise ValueError(
+            f"backbone {backbone.shape} does not hold {L} residues x "
+            f"{per} atoms x 3"
+        )
     coords = backbone.reshape(L * per, 3)
     n = L * per
     return PDBStructure(

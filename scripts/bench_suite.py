@@ -264,13 +264,29 @@ def main():
             r = {"config": key, "error": f"{type(e).__name__}: {e}"[:300]}
         results.append(r)
         print(json.dumps(r), flush=True)
-    out = {
-        "device": jax.devices()[0].device_kind,
-        "smoke": SMOKE,
-        "results": results,
-    }
+    device = jax.devices()[0].device_kind
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "BENCH_SUITE.json")
+    # a subset run must MERGE into the existing suite, not clobber the
+    # other configs' rows — but only when the rows are comparable (same
+    # device, same smoke setting); a first TPU run replaces CPU smoke rows
+    # wholesale
+    merged = results
+    if os.path.exists(path) and which != list(CONFIGS):
+        try:
+            with open(path) as f:
+                prior = json.load(f)
+            if prior.get("device") == device and prior.get("smoke") == SMOKE:
+                by_key = {
+                    r["config"].split(":", 1)[0]: r
+                    for r in prior.get("results", [])
+                }
+                for r in results:
+                    by_key[r["config"].split(":", 1)[0]] = r
+                merged = [by_key[k] for k in sorted(by_key)]
+        except (OSError, ValueError, KeyError):
+            pass  # unreadable prior file: write this run's rows alone
+    out = {"device": device, "smoke": SMOKE, "results": merged}
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(f"wrote {path}")

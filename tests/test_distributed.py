@@ -27,7 +27,7 @@ def test_pod_mesh_shapes():
     # -1 fills dp with the remaining devices
     assert pod_mesh(-1, 2).devices.shape == (4, 2)
     assert pod_mesh().devices.shape == (8, 1)
-    with pytest.raises(AssertionError):
+    with pytest.raises(ValueError):
         pod_mesh(3, 2)
 
 

@@ -86,7 +86,7 @@ def test_npz_dataset_validates(tmp_path):
     cfg = DataConfig(source="npz", data_dir=str(tmp_path / "empty"))
     with pytest.raises(FileNotFoundError, match="no .npz shards"):
         NpzShardDataset(cfg)
-    with pytest.raises(AssertionError, match="data_dir"):
+    with pytest.raises(ValueError, match="data_dir"):
         NpzShardDataset(DataConfig(source="npz", data_dir=None))
 
 

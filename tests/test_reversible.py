@@ -179,7 +179,7 @@ def test_reversible_requires_msa():
 
     x = jnp.zeros((1, 4, 4, D))
     t = Trunk(dim=D, depth=1, heads=2, dim_head=8, reversible=True)
-    with pytest.raises(AssertionError):
+    with pytest.raises(ValueError):
         t.init(jax.random.key(0), x, None)
 
 
@@ -193,7 +193,7 @@ def test_reversible_rejects_grid_parallel():
     m = jnp.zeros((1, 2, 4, D))
     t = Trunk(dim=D, depth=1, heads=2, dim_head=8, reversible=True,
               grid_parallel=True)
-    with pytest.raises(AssertionError, match="grid_parallel"):
+    with pytest.raises(ValueError, match="grid_parallel"):
         t.init(jax.random.key(0), x, m)
 
 

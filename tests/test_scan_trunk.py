@@ -98,5 +98,5 @@ def test_scan_rejects_heterogeneous_sparse():
     seq, msa, mask, msa_mask = _inputs()
     model = Alphafold2(scan_layers=True, sparse_self_attn=(True, False, True),
                        **KW)
-    with pytest.raises(AssertionError, match="homogeneous"):
+    with pytest.raises(ValueError, match="homogeneous"):
         model.init(jax.random.key(5), seq, msa, mask=mask, msa_mask=msa_mask)

@@ -121,5 +121,5 @@ def test_dense_fallback_without_mesh():
 def test_ulysses_rejects_indivisible_heads():
     q, k, v = _qkv(jax.random.key(4), h=3)
     mesh = make_mesh(1, 8)
-    with pytest.raises(AssertionError, match="heads"):
+    with pytest.raises(ValueError, match="heads"):
         sequence_parallel_attention(q, k, v, mesh=mesh, impl="ulysses")
