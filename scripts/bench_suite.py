@@ -267,16 +267,22 @@ def main():
     device = jax.devices()[0].device_kind
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "BENCH_SUITE.json")
-    # a subset run must MERGE into the existing suite, not clobber the
-    # other configs' rows — but only when the rows are comparable (same
-    # device, same smoke setting); a first TPU run replaces CPU smoke rows
-    # wholesale
+    write_results(path, results, device, SMOKE,
+                  partial=which != list(CONFIGS))
+    print(f"wrote {path}")
+
+
+def write_results(path, results, device, smoke, partial):
+    """Write the suite file. A subset (``partial``) run MERGES into the
+    existing rows instead of clobbering the configs it did not run — but
+    only when the rows are comparable (same device, same smoke setting);
+    a first TPU run replaces CPU smoke rows wholesale."""
     merged = results
-    if os.path.exists(path) and which != list(CONFIGS):
+    if partial and os.path.exists(path):
         try:
             with open(path) as f:
                 prior = json.load(f)
-            if prior.get("device") == device and prior.get("smoke") == SMOKE:
+            if prior.get("device") == device and prior.get("smoke") == smoke:
                 by_key = {
                     r["config"].split(":", 1)[0]: r
                     for r in prior.get("results", [])
@@ -286,10 +292,10 @@ def main():
                 merged = [by_key[k] for k in sorted(by_key)]
         except (OSError, ValueError, KeyError):
             pass  # unreadable prior file: write this run's rows alone
-    out = {"device": device, "smoke": SMOKE, "results": merged}
     with open(path, "w") as f:
-        json.dump(out, f, indent=2)
-    print(f"wrote {path}")
+        json.dump(
+            {"device": device, "smoke": smoke, "results": merged}, f, indent=2
+        )
 
 
 if __name__ == "__main__":
