@@ -25,6 +25,9 @@ class ModelConfig:
     dim_head: int = 64
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
+    # exact erf GELU in the GEGLU feedforwards (the reference's torch
+    # F.gelu); default False = tanh approximation, the faster form on TPU
+    gelu_exact: bool = False
     remat: bool = False
     # remat checkpoint policy: None/"nothing" (save nothing — max memory
     # savings) | "dots" | "dots_no_batch" (save matmul outputs: backward

@@ -50,6 +50,7 @@ class TemplateBlock(nn.Module):
     heads: int
     dim_head: int
     dropout: float = 0.0
+    gelu_exact: bool = False
     use_flash: Optional[bool] = None
     dtype: jnp.dtype = jnp.float32
 
@@ -91,7 +92,8 @@ class TemplateBlock(nn.Module):
         x, t = y[:, 0], y[:, 1:]
 
         t = t + FeedForward(
-            dim=self.dim, dropout=self.dropout, dtype=self.dtype, name="template_ff"
+            dim=self.dim, dropout=self.dropout, gelu_exact=self.gelu_exact,
+            dtype=self.dtype, name="template_ff"
         )(ln("template_ff_norm")(t), deterministic=deterministic)
         return x, t
 
@@ -116,6 +118,7 @@ class Alphafold2(nn.Module):
     max_num_templates: int = constants.MAX_NUM_TEMPLATES
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
+    gelu_exact: bool = False  # erf GELU (the reference's torch F.gelu)
     remat: bool = False
     remat_policy: Optional[str] = None  # None/"nothing" | "dots" | "dots_no_batch"
     reversible: bool = False  # true inversion-based reversible trunk engine
@@ -274,7 +277,8 @@ class Alphafold2(nn.Module):
             for i in range(self.template_attn_depth):
                 x, t = TemplateBlock(
                     dim=self.dim, heads=self.heads, dim_head=self.dim_head,
-                    dropout=self.attn_dropout, use_flash=self.use_flash,
+                    dropout=self.attn_dropout, gelu_exact=self.gelu_exact,
+                    use_flash=self.use_flash,
                     dtype=dt, name=f"template_block_{i}",
                 )(x, t, pair_mask, t_mask, deterministic=deterministic)
             x = shard_pair(x)
@@ -287,6 +291,7 @@ class Alphafold2(nn.Module):
             dim_head=self.dim_head,
             attn_dropout=self.attn_dropout,
             ff_dropout=self.ff_dropout,
+            gelu_exact=self.gelu_exact,
             sparse_self_attn=self.sparse_self_attn,
             seq_len=self.max_seq_len,
             sparse_config=self.sparse_config,

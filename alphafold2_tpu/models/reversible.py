@@ -70,6 +70,7 @@ class RevLayerPair(nn.Module):
     dim_head: int = 64
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
+    gelu_exact: bool = False  # erf GELU (the reference's torch F.gelu)
     sparse_attn: bool = False
     seq_len: Optional[int] = None
     sparse_config: Optional[object] = None
@@ -92,11 +93,11 @@ class RevLayerPair(nn.Module):
             sparse_use_pallas=self.sparse_use_pallas, **ax,
         )
         self.g_s_norm = nn.LayerNorm(dtype=dt)
-        self.g_s = FeedForward(dim=self.dim, dropout=self.ff_dropout, dtype=dt)
+        self.g_s = FeedForward(dim=self.dim, dropout=self.ff_dropout, gelu_exact=self.gelu_exact, dtype=dt)
         self.j_s_norm = nn.LayerNorm(dtype=dt)
         self.j_s = AxialAttention(tie_row_attn=self.msa_tie_row_attn, **ax)
         self.k_s_norm = nn.LayerNorm(dtype=dt)
-        self.k_s = FeedForward(dim=self.dim, dropout=self.ff_dropout, dtype=dt)
+        self.k_s = FeedForward(dim=self.dim, dropout=self.ff_dropout, gelu_exact=self.gelu_exact, dtype=dt)
 
         at = dict(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
@@ -106,12 +107,12 @@ class RevLayerPair(nn.Module):
         self.f_c_ctx_norm = nn.LayerNorm(dtype=dt)
         self.f_c = Attention(compress_ratio=self.cross_attn_compress_ratio, **at)
         self.g_c_norm = nn.LayerNorm(dtype=dt)
-        self.g_c = FeedForward(dim=self.dim, dropout=self.ff_dropout, dtype=dt)
+        self.g_c = FeedForward(dim=self.dim, dropout=self.ff_dropout, gelu_exact=self.gelu_exact, dtype=dt)
         self.j_c_norm = nn.LayerNorm(dtype=dt)
         self.j_c_ctx_norm = nn.LayerNorm(dtype=dt)
         self.j_c = Attention(**at)
         self.k_c_norm = nn.LayerNorm(dtype=dt)
-        self.k_c = FeedForward(dim=self.dim, dropout=self.ff_dropout, dtype=dt)
+        self.k_c = FeedForward(dim=self.dim, dropout=self.ff_dropout, gelu_exact=self.gelu_exact, dtype=dt)
 
     # --- the eight sub-functions (each used once per direction) ---
 
@@ -251,6 +252,7 @@ class ReversibleTrunk(nn.Module):
     dim_head: int = 64
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
+    gelu_exact: bool = False  # erf GELU (the reference's torch F.gelu)
     sparse_attn: bool = False
     seq_len: Optional[int] = None
     sparse_config: Optional[object] = None
@@ -280,6 +282,7 @@ class ReversibleTrunk(nn.Module):
         template = RevLayerPair(
             dim=self.dim, heads=self.heads, dim_head=self.dim_head,
             attn_dropout=self.attn_dropout, ff_dropout=self.ff_dropout,
+            gelu_exact=self.gelu_exact,
             sparse_attn=self.sparse_attn, seq_len=self.seq_len,
             sparse_config=self.sparse_config,
             sparse_use_pallas=self.sparse_use_pallas,

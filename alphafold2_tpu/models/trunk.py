@@ -47,6 +47,7 @@ class TrunkLayer(nn.Module):
     dim_head: int = 64
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
+    gelu_exact: bool = False  # erf GELU (the reference's torch F.gelu)
     sparse_attn: bool = False
     seq_len: Optional[int] = None
     sparse_config: Optional[object] = None  # ops.sparse.BlockSparseConfig
@@ -152,12 +153,14 @@ class TrunkLayer(nn.Module):
 
         # feedforwards
         x = x + FeedForward(
-            dim=self.dim, dropout=self.ff_dropout, dtype=dt, name="pair_ff"
+            dim=self.dim, dropout=self.ff_dropout,
+            gelu_exact=self.gelu_exact, dtype=dt, name="pair_ff"
         )(ln("pair_ff_norm")(x), deterministic=deterministic)
         x = shard_pair(x)
         if m is not None:
             m = m + FeedForward(
-                dim=self.dim, dropout=self.ff_dropout, dtype=dt, name="msa_ff"
+                dim=self.dim, dropout=self.ff_dropout,
+                gelu_exact=self.gelu_exact, dtype=dt, name="msa_ff"
             )(ln("msa_ff_norm")(m), deterministic=deterministic)
             m = shard_msa(m, rows=self.msa_row_shard)
 
@@ -236,6 +239,7 @@ class Trunk(nn.Module):
     dim_head: int = 64
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
+    gelu_exact: bool = False  # erf GELU (the reference's torch F.gelu)
     sparse_self_attn: tuple | bool = False
     seq_len: Optional[int] = None
     sparse_config: Optional[object] = None  # ops.sparse.BlockSparseConfig
@@ -259,6 +263,7 @@ class Trunk(nn.Module):
             dim_head=self.dim_head,
             attn_dropout=self.attn_dropout,
             ff_dropout=self.ff_dropout,
+            gelu_exact=self.gelu_exact,
             sparse_attn=sparse,
             seq_len=self.seq_len,
             sparse_config=self.sparse_config,
@@ -341,6 +346,7 @@ class Trunk(nn.Module):
                 dim_head=self.dim_head,
                 attn_dropout=self.attn_dropout,
                 ff_dropout=self.ff_dropout,
+                gelu_exact=self.gelu_exact,
                 sparse_attn=sparse_flags[0],
                 seq_len=self.seq_len,
                 sparse_config=self.sparse_config,

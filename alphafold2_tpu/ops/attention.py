@@ -70,11 +70,19 @@ def grid_axial_project_attend(
 
 
 class FeedForward(nn.Module):
-    """GEGLU feedforward: Linear(d -> 2*mult*d) -> gated GELU -> Linear(mult*d -> d)."""
+    """GEGLU feedforward: Linear(d -> 2*mult*d) -> gated GELU -> Linear(mult*d -> d).
+
+    ``gelu_exact``: the reference's torch ``F.gelu`` is the exact erf form
+    (alphafold2.py:57); jax defaults to the tanh approximation, which is
+    the faster choice on TPU and stays the default here — the flag exists
+    so matched head-to-heads can eliminate the one remaining systematic
+    functional divergence from the reference block.
+    """
 
     dim: int
     mult: int = 4
     dropout: float = 0.0
+    gelu_exact: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -82,7 +90,7 @@ class FeedForward(nn.Module):
         inner = self.dim * self.mult
         h = nn.Dense(inner * 2, dtype=self.dtype, name="wi")(x)
         h, gates = jnp.split(h, 2, axis=-1)
-        h = h * jax.nn.gelu(gates)
+        h = h * jax.nn.gelu(gates, approximate=not self.gelu_exact)
         h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
         return nn.Dense(self.dim, dtype=self.dtype, name="wo")(h)
 

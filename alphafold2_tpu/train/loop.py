@@ -82,6 +82,7 @@ def build_model(cfg: Config) -> Alphafold2:
         dim_head=m.dim_head,
         attn_dropout=m.attn_dropout,
         ff_dropout=m.ff_dropout,
+        gelu_exact=m.gelu_exact,
         remat=m.remat,
         remat_policy=m.remat_policy,
         reversible=m.reversible,

@@ -51,6 +51,9 @@ def main() -> int:
     # re-draw params under the reference's torch module defaults
     # (models/init.py) — isolates init distributions in the head-to-head
     ap.add_argument("--torch-init", action="store_true")
+    # exact erf GELU (the reference's torch F.gelu) instead of the tanh
+    # approximation — the remaining known systematic functional divergence
+    ap.add_argument("--exact-gelu", action="store_true")
     ap.add_argument("--bf16", action="store_true")  # default f32 = torch CPU
     ap.add_argument("--holdout-dir", default=None)
     ap.add_argument("--batch-size", type=int, default=1)
@@ -99,7 +102,7 @@ def main() -> int:
             dim=args.dim, depth=args.depth, heads=args.heads,
             dim_head=args.dim_head, max_seq_len=args.crop * 2,
             msa_tie_row_attn=args.tie_rows, bfloat16=args.bf16,
-            reversible=args.reversible,
+            reversible=args.reversible, gelu_exact=args.exact_gelu,
         ),
         data=data_cfg,
     )
@@ -204,6 +207,7 @@ def main() -> int:
             "dtype": "bf16" if args.bf16 else "f32",
             "engine": "reversible" if args.reversible else "default",
             "init": "torch" if args.torch_init else "flax",
+            "gelu": "exact" if args.exact_gelu else "tanh",
         },
         "final_train_ce": round(step_ce, 4),
         "eval_ce": round(eval_ce, 4),
