@@ -31,16 +31,40 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# Mosaic tiling: the last two dims of every block must be (8k, 128k) or
+# equal the full array dims. Row-wise stats (lse, dsum) therefore ride as
+# (bh, n, _LANES) lane-replicated tensors and the per-key bias as a
+# (b, _SUB, n) sublane-replicated tensor — the same idiom as the in-tree
+# jax.experimental.pallas.ops.tpu.flash_attention (l/m stored with a
+# MIN_BLOCK_SIZE=128 trailing dim). A bare (bh, n) with block (1, block)
+# fails the compiled lowering (sublane dim 1), which interpret mode never
+# surfaces.
+_LANES = 128
+_SUB = 8
 
-def _kernel(
+
+def _rep_rows(stat, width):
+    """(block, _LANES) lane-replicated row stat -> (block, width), matching
+    a (block_q, width) logits tile. Every lane holds the row value, so
+    slicing or tiling both preserve semantics."""
+    lanes = stat.shape[-1]
+    if width == lanes:
+        return stat
+    if width < lanes:
+        return stat[:, :width]
+    reps = -(-width // lanes)
+    return jnp.tile(stat, (1, reps))[:, :width]
+
+
+def _fwd_core(
     idx_ref,  # scalar prefetch: (nb, A) int32 active block ids
     valid_ref,  # scalar prefetch: (nb, A) int32 validity flags
     q_ref,  # (1, block, d)
     k_ref,  # (1, block, d) — the a-th active KV block for this q row
     v_ref,  # (1, block, d)
-    kmask_ref,  # (1, block) f32 additive key-padding bias (0 or NEG_INF)
+    kmask_ref,  # (1, _SUB, block) f32 additive key-padding bias (0/NEG_INF)
     o_ref,  # (1, block, d)
-    lse_ref,  # (1, block) f32 logsumexp out (for the backward kernels)
+    lse_ref,  # (1, block, _LANES) f32 lane-replicated logsumexp, or None
     m_scr,  # (block, 1) f32 running max
     l_scr,  # (block, 1) f32 running sum
     acc_scr,  # (block, d) f32 accumulator
@@ -68,7 +92,7 @@ def _kernel(
     )  # (block, block)
 
     valid_bias = jnp.where(valid_ref[qi, a] > 0, 0.0, NEG_INF)
-    dots = dots + kmask_ref[0][None, :] + valid_bias
+    dots = dots + kmask_ref[0][:1, :] + valid_bias
 
     m_prev = m_scr[:]  # (block, 1)
     m_new = jnp.maximum(m_prev, jnp.max(dots, axis=-1, keepdims=True))
@@ -85,8 +109,25 @@ def _kernel(
     def _finalize():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        # logsumexp per q row, consumed by the backward kernels
-        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+        if lse_ref is not None:
+            # logsumexp per q row, lane-replicated for the backward kernels
+            lse_ref[0] = jnp.broadcast_to(
+                m_scr[:] + jnp.log(l), lse_ref.shape[1:]
+            )
+
+
+def _kernel(idx_ref, valid_ref, q_ref, k_ref, v_ref, kmask_ref, o_ref,
+            lse_ref, m_scr, l_scr, acc_scr, *, scale: float):
+    _fwd_core(idx_ref, valid_ref, q_ref, k_ref, v_ref, kmask_ref, o_ref,
+              lse_ref, m_scr, l_scr, acc_scr, scale=scale)
+
+
+def _kernel_no_lse(idx_ref, valid_ref, q_ref, k_ref, v_ref, kmask_ref,
+                   o_ref, m_scr, l_scr, acc_scr, *, scale: float):
+    # forward-only variant: no (bh, n, _LANES) lse output allocated or
+    # written — inference/no-grad calls skip that 128x-replicated HBM write
+    _fwd_core(idx_ref, valid_ref, q_ref, k_ref, v_ref, kmask_ref, o_ref,
+              None, m_scr, l_scr, acc_scr, scale=scale)
 
 
 def _dq_kernel(
@@ -94,11 +135,11 @@ def _dq_kernel(
     valid_ref,  # scalar prefetch: (nb, A)
     q_ref,  # (1, block, d)
     g_ref,  # (1, block, d) upstream cotangent dO for this q block
-    lse_ref,  # (1, block) f32 logsumexp per q row
-    dsum_ref,  # (1, block) f32 D = rowsum(dO * O)
+    lse_ref,  # (1, block, _LANES) f32 logsumexp per q row (lane-replicated)
+    dsum_ref,  # (1, block, _LANES) f32 D = rowsum(dO * O) (lane-replicated)
     k_ref,  # (1, block, d) a-th active kv block
     v_ref,  # (1, block, d)
-    kmask_ref,  # (1, block) f32 additive key bias
+    kmask_ref,  # (1, _SUB, block) f32 additive key bias
     dq_ref,  # (1, block, d) out
     dq_scr,  # (block, d) f32 accumulator
     *,
@@ -119,14 +160,15 @@ def _dq_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         * scale
-        + kmask_ref[0][None, :]
+        + kmask_ref[0][:1, :]
         + valid_bias
     )
-    p = jnp.exp(dots - lse_ref[0][:, None])  # (block, block) normalized probs
+    # (block, block) normalized probs
+    p = jnp.exp(dots - _rep_rows(lse_ref[0], dots.shape[1]))
     dp = jax.lax.dot_general(
         g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-    ds = p * (dp - dsum_ref[0][:, None])
+    ds = p * (dp - _rep_rows(dsum_ref[0], dp.shape[1]))
     dq_scr[:] = dq_scr[:] + scale * jax.lax.dot_general(
         ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -142,11 +184,11 @@ def _dkv_kernel(
     valid_ref,  # scalar prefetch: (nbk, At)
     k_ref,  # (1, block, d) this kv block
     v_ref,  # (1, block, d)
-    kmask_ref,  # (1, block) f32 additive key bias for this kv block
+    kmask_ref,  # (1, _SUB, block) f32 additive key bias for this kv block
     q_ref,  # (1, block, d) a-th attending q block
     g_ref,  # (1, block, d)
-    lse_ref,  # (1, block)
-    dsum_ref,  # (1, block)
+    lse_ref,  # (1, block, _LANES) lane-replicated
+    dsum_ref,  # (1, block, _LANES) lane-replicated
     dk_ref,  # (1, block, d) out
     dv_ref,  # (1, block, d) out
     dk_scr,  # (block, d) f32
@@ -170,10 +212,11 @@ def _dkv_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         * scale
-        + kmask_ref[0][None, :]
+        + kmask_ref[0][:1, :]
         + valid_bias
     )
-    p = jnp.exp(dots - lse_ref[0][:, None])  # (block_q, block_k)
+    # (block_q, block_k)
+    p = jnp.exp(dots - _rep_rows(lse_ref[0], dots.shape[1]))
     dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
         p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -181,7 +224,7 @@ def _dkv_kernel(
     dp = jax.lax.dot_general(
         g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-    ds = p * (dp - dsum_ref[0][:, None])
+    ds = p * (dp - _rep_rows(dsum_ref[0], dp.shape[1]))
     dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
         ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -193,15 +236,17 @@ def _dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
-def _run(q, k, v, kmask_bias, idx, valid, block_size, interpret):
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "interpret", "with_lse")
+)
+def _run(q, k, v, kmask8, idx, valid, block_size, interpret, with_lse=True):
     # the kernel is layout-agnostic: idx/valid ride in as runtime
     # scalar-prefetch operands, so distinct layouts with the same shapes
     # share one compilation
     bh, n, d = q.shape
     nb = n // block_size
     A = idx.shape[1]
-    b = kmask_bias.shape[0]
+    b = kmask8.shape[0]
     heads = bh // b
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -220,43 +265,50 @@ def _run(q, k, v, kmask_bias, idx, valid, block_size, interpret):
                 lambda bh_, qi, a, idx_, val_: (bh_, idx_[qi, a], 0),
             ),
             pl.BlockSpec(
-                (1, block_size),
-                lambda bh_, qi, a, idx_, val_, h=heads: (bh_ // h, idx_[qi, a]),
+                (1, _SUB, block_size),
+                lambda bh_, qi, a, idx_, val_, h=heads:
+                (bh_ // h, 0, idx_[qi, a]),
             ),
         ],
         out_specs=[
             pl.BlockSpec(
                 (1, block_size, d), lambda bh_, qi, a, idx_, val_: (bh_, qi, 0)
             ),
+        ] + ([
             pl.BlockSpec(
-                (1, block_size), lambda bh_, qi, a, idx_, val_: (bh_, qi)
+                (1, block_size, _LANES),
+                lambda bh_, qi, a, idx_, val_: (bh_, qi, 0),
             ),
-        ],
+        ] if with_lse else []),
         scratch_shapes=[
             pltpu.VMEM((block_size, 1), jnp.float32),
             pltpu.VMEM((block_size, 1), jnp.float32),
             pltpu.VMEM((block_size, d), jnp.float32),
         ],
     )
-    kernel = functools.partial(_kernel, scale=d**-0.5)
-    return pl.pallas_call(
+    kernel = functools.partial(
+        _kernel if with_lse else _kernel_no_lse, scale=d**-0.5
+    )
+    out_shape = [jax.ShapeDtypeStruct((bh, n, d), q.dtype)] + (
+        [jax.ShapeDtypeStruct((bh, n, _LANES), jnp.float32)]
+        if with_lse else []
+    )
+    res = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, n), jnp.float32),
-        ],
+        out_shape=out_shape,
         interpret=interpret,
-    )(idx, valid, q, k, v, kmask_bias)
+    )(idx, valid, q, k, v, kmask8)
+    return (res[0], res[1]) if with_lse else (res[0], None)
 
 
 @functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
-def _run_dq(q, g, lse, dsum, k, v, kmask_bias, idx, valid, block_size,
+def _run_dq(q, g, lse_l, dsum_l, k, v, kmask8, idx, valid, block_size,
             interpret):
     bh, n, d = q.shape
     nb = n // block_size
     A = idx.shape[1]
-    b = kmask_bias.shape[0]
+    b = kmask8.shape[0]
     heads = bh // b
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -267,17 +319,17 @@ def _run_dq(q, g, lse, dsum, k, v, kmask_bias, idx, valid, block_size,
                          lambda bh_, qi, a, idx_, val_: (bh_, qi, 0)),
             pl.BlockSpec((1, block_size, d),
                          lambda bh_, qi, a, idx_, val_: (bh_, qi, 0)),
-            pl.BlockSpec((1, block_size),
-                         lambda bh_, qi, a, idx_, val_: (bh_, qi)),
-            pl.BlockSpec((1, block_size),
-                         lambda bh_, qi, a, idx_, val_: (bh_, qi)),
+            pl.BlockSpec((1, block_size, _LANES),
+                         lambda bh_, qi, a, idx_, val_: (bh_, qi, 0)),
+            pl.BlockSpec((1, block_size, _LANES),
+                         lambda bh_, qi, a, idx_, val_: (bh_, qi, 0)),
             pl.BlockSpec((1, block_size, d),
                          lambda bh_, qi, a, idx_, val_: (bh_, idx_[qi, a], 0)),
             pl.BlockSpec((1, block_size, d),
                          lambda bh_, qi, a, idx_, val_: (bh_, idx_[qi, a], 0)),
-            pl.BlockSpec((1, block_size),
+            pl.BlockSpec((1, _SUB, block_size),
                          lambda bh_, qi, a, idx_, val_, h=heads:
-                         (bh_ // h, idx_[qi, a])),
+                         (bh_ // h, 0, idx_[qi, a])),
         ],
         out_specs=pl.BlockSpec((1, block_size, d),
                                lambda bh_, qi, a, idx_, val_: (bh_, qi, 0)),
@@ -289,16 +341,16 @@ def _run_dq(q, g, lse, dsum, k, v, kmask_bias, idx, valid, block_size,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
         interpret=interpret,
-    )(idx, valid, q, g, lse, dsum, k, v, kmask_bias)
+    )(idx, valid, q, g, lse_l, dsum_l, k, v, kmask8)
 
 
 @functools.partial(jax.jit, static_argnames=("block_size", "interpret"))
-def _run_dkv(k, v, kmask_bias, q, g, lse, dsum, idx_t, valid_t, block_size,
-             interpret):
+def _run_dkv(k, v, kmask8, q, g, lse_l, dsum_l, idx_t, valid_t,
+             block_size, interpret):
     bh, n, d = q.shape
     nbk = n // block_size
     At = idx_t.shape[1]
-    b = kmask_bias.shape[0]
+    b = kmask8.shape[0]
     heads = bh // b
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -309,17 +361,17 @@ def _run_dkv(k, v, kmask_bias, q, g, lse, dsum, idx_t, valid_t, block_size,
                          lambda bh_, kj, a, idx_, val_: (bh_, kj, 0)),
             pl.BlockSpec((1, block_size, d),
                          lambda bh_, kj, a, idx_, val_: (bh_, kj, 0)),
-            pl.BlockSpec((1, block_size),
+            pl.BlockSpec((1, _SUB, block_size),
                          lambda bh_, kj, a, idx_, val_, h=heads:
-                         (bh_ // h, kj)),
+                         (bh_ // h, 0, kj)),
             pl.BlockSpec((1, block_size, d),
                          lambda bh_, kj, a, idx_, val_: (bh_, idx_[kj, a], 0)),
             pl.BlockSpec((1, block_size, d),
                          lambda bh_, kj, a, idx_, val_: (bh_, idx_[kj, a], 0)),
-            pl.BlockSpec((1, block_size),
-                         lambda bh_, kj, a, idx_, val_: (bh_, idx_[kj, a])),
-            pl.BlockSpec((1, block_size),
-                         lambda bh_, kj, a, idx_, val_: (bh_, idx_[kj, a])),
+            pl.BlockSpec((1, block_size, _LANES),
+                         lambda bh_, kj, a, idx_, val_: (bh_, idx_[kj, a], 0)),
+            pl.BlockSpec((1, block_size, _LANES),
+                         lambda bh_, kj, a, idx_, val_: (bh_, idx_[kj, a], 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_size, d),
@@ -341,7 +393,7 @@ def _run_dkv(k, v, kmask_bias, q, g, lse, dsum, idx_t, valid_t, block_size,
             jax.ShapeDtypeStruct((bh, n, d), v.dtype),
         ],
         interpret=interpret,
-    )(idx_t, valid_t, k, v, kmask_bias, q, g, lse, dsum)
+    )(idx_t, valid_t, k, v, kmask8, q, g, lse_l, dsum_l)
 
 
 def _prep(q, mask, layout):
@@ -355,7 +407,9 @@ def _prep(q, mask, layout):
         kmask_bias = jnp.zeros((b, n), dtype=jnp.float32)
     else:
         kmask_bias = jnp.where(mask, 0.0, NEG_INF).astype(jnp.float32)
-    return idx_j, valid_j, kmask_bias
+    # sublane-replicated once here; every kernel takes it in this layout
+    kmask8 = jnp.broadcast_to(kmask_bias[:, None, :], (b, _SUB, n))
+    return idx_j, valid_j, kmask8
 
 
 def pallas_block_sparse_attention(
@@ -374,17 +428,19 @@ def pallas_block_sparse_attention(
     b, h, n, d = q.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    idx_j, valid_j, kmask_bias = _prep(q, mask, layout)
+    idx_j, valid_j, kmask8 = _prep(q, mask, layout)
 
     qf = q.reshape(b * h, n, d)
     kf = k.reshape(b * h, n, d)
     vf = v.reshape(b * h, n, d)
     out, lse = _run(
-        qf, kf, vf, kmask_bias, idx_j, valid_j, block_size, interpret
+        qf, kf, vf, kmask8, idx_j, valid_j, block_size, interpret,
+        with_lse=return_lse,
     )
     out = out.reshape(b, h, n, d)
     if return_lse:
-        return out, lse.reshape(b, h, n)
+        # lane 0 of the lane-replicated (bh, n, _LANES) internal layout
+        return out, lse[..., 0].reshape(b, h, n)
     return out
 
 
@@ -407,7 +463,7 @@ def pallas_block_sparse_attention_bwd(
     b, h, n, d = q.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    idx_j, valid_j, kmask_bias = _prep(q, mask, layout)
+    idx_j, valid_j, kmask8 = _prep(q, mask, layout)
     # column-wise active lists: which q blocks attend each kv block
     from alphafold2_tpu.ops.sparse import active_indices
 
@@ -420,15 +476,21 @@ def pallas_block_sparse_attention_bwd(
     vf = v.reshape(b * h, n, d)
     gf = g.reshape(b * h, n, d)
     of = out.reshape(b * h, n, d)
-    lsef = lse.reshape(b * h, n)
+    # lane-replicate the row stats ONCE for both backward kernels (the
+    # forward's replicated lse was sliced to lane 0 at the public boundary)
+    bh = b * h
+    lse_l = jnp.broadcast_to(
+        lse.reshape(bh, n)[..., None], (bh, n, _LANES)
+    )
     dsum = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32), axis=-1)
+    dsum_l = jnp.broadcast_to(dsum[..., None], (bh, n, _LANES))
 
     dq = _run_dq(
-        qf, gf, lsef, dsum, kf, vf, kmask_bias, idx_j, valid_j, block_size,
+        qf, gf, lse_l, dsum_l, kf, vf, kmask8, idx_j, valid_j, block_size,
         interpret,
     )
     dk, dv = _run_dkv(
-        kf, vf, kmask_bias, qf, gf, lsef, dsum, idx_t, valid_t, block_size,
+        kf, vf, kmask8, qf, gf, lse_l, dsum_l, idx_t, valid_t, block_size,
         interpret,
     )
     return (

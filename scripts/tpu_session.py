@@ -155,6 +155,12 @@ def stage_baseline():
         return "skipped (--no-rebaseline)"
     if not bench_res.get("ok") or not rec.get("value"):
         raise RuntimeError("no flagship bench measurement to record")
+    if rec.get("implausible"):
+        raise RuntimeError(
+            "refusing to record an implausible (> peak FLOPs) measurement "
+            "as the baseline — the timed region did not sync with device "
+            "completion"
+        )
     if jax.devices()[0].platform == "cpu":
         raise RuntimeError("refusing to record a CPU run as the TPU baseline")
     if bench.config_overridden():
