@@ -165,7 +165,7 @@ def block_sparse_attention(
 
 
 def block_sparse_attention_pallas(
-    q, k, v, layout: np.ndarray, block_size: int, mask=None
+    q, k, v, layout: np.ndarray, block_size: int, mask=None, interpret=None
 ):
     """Pallas forward + fused Pallas backward.
 
@@ -176,6 +176,10 @@ def block_sparse_attention_pallas(
     probabilities from q/k and the saved logsumexp. Nothing quadratic is
     saved or materialized in either direction. Gradient parity with the
     gather-based jnp oracle is proven in tests/test_sparse.py.
+
+    ``interpret``: None = compiled on TPU, interpret elsewhere (the kernel
+    default); the lowering gate (scripts/check_tpu_lowering.py) forces
+    False to exercise the Mosaic pipeline off-hardware.
     """
 
     @jax.custom_vjp
@@ -185,7 +189,7 @@ def block_sparse_attention_pallas(
         )
 
         return pallas_block_sparse_attention(
-            q, k, v, layout, block_size, mask=mask
+            q, k, v, layout, block_size, mask=mask, interpret=interpret
         )
 
     def fwd(q, k, v, mask):
@@ -194,7 +198,8 @@ def block_sparse_attention_pallas(
         )
 
         out, lse = pallas_block_sparse_attention(
-            q, k, v, layout, block_size, mask=mask, return_lse=True
+            q, k, v, layout, block_size, mask=mask, return_lse=True,
+            interpret=interpret,
         )
         return out, (q, k, v, out, lse, mask)
 
@@ -205,7 +210,8 @@ def block_sparse_attention_pallas(
         )
 
         dq, dk, dv = pallas_block_sparse_attention_bwd(
-            q, k, v, out, lse, g, layout, block_size, mask=mask
+            q, k, v, out, lse, g, layout, block_size, mask=mask,
+            interpret=interpret,
         )
         return dq, dk, dv, None
 

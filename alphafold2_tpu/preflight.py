@@ -28,6 +28,26 @@ _PROBE = (
 )
 
 
+def scrub_axon_env(env: dict | None = None) -> dict:
+    """A copy of ``env`` (default os.environ) with the axon tunnel hook
+    removed: no ``.axon_site`` PYTHONPATH entry (its sitecustomize patches
+    jax's backend lookup at interpreter start), no PALLAS_AXON/AXON_ vars,
+    platform forced to CPU. The single source of truth for "run a
+    subprocess on the host backend, never the tunnel" — used by the TPU
+    lowering gate (cross-platform lowering hangs through the hook) and by
+    tests of the liveness probe."""
+    env = dict(os.environ if env is None else env)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p
+    )
+    for k in list(env):
+        if k.startswith(("PALLAS_AXON", "AXON_")):
+            del env[k]
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
 def _probe_ok(extra_env: dict | None = None, timeout: int = 240) -> bool:
     """Run one tiny jax computation in a subprocess; True iff it completes."""
     try:

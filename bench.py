@@ -97,6 +97,72 @@ _PHASE = {"name": "startup"}
 # window yields a nonzero number (VERDICT r3 #1a)
 _FIRST_LIGHT = {"record": None}
 
+# one clock validation per process (first_light + flagship share it)
+_CLOCK = {"probe": None}
+
+
+def _clock_probe(m: int | None = None, size: int = 4096, iters: int = 4):
+    """Validate that the timing sync actually tracks device completion.
+
+    Round 1 and round 4 both recorded physically impossible throughput
+    because the tunneled backend acknowledged block_until_ready (and
+    possibly device_get) before the device finished. The >peak-FLOPs guard
+    only catches inflation past 100% MFU; a partially-async clock inflating
+    3x at a true 10% MFU passes it silently (ADVICE r4). This probe times
+    the SAME dispatch count at two in-graph work factors — a scan of M vs
+    2M chained matmuls. The dispatch/ack path is identical for both, so a
+    device-tracking clock shows ~2x elapsed; an early-acking clock shows
+    ~1x. No ground-truth step cost is needed.
+    """
+    m = m or _env_int("AF2TPU_CLOCK_PROBE_CHAIN", 384)
+    x = jnp.ones((size, size), jnp.bfloat16)
+
+    def chain(n):
+        def body(c, _):
+            return (c @ x) * (1.0 / size), ()
+
+        def f(x0):
+            out, _ = jax.lax.scan(body, x0, None, length=n)
+            return jnp.sum(out[:1, :1].astype(jnp.float32))
+
+        return jax.jit(f)
+
+    times = []
+    for f in (chain(m), chain(2 * m)):
+        s = f(x)
+        jax.device_get(s)  # compile + warm outside the timed region
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            s = f(x)
+        jax.device_get(s)
+        times.append(time.perf_counter() - t0)
+    # The verdict is physics, not a fixed ratio (a fixed threshold falsely
+    # flags an honest clock behind a high-latency relay, where the constant
+    # round-trip compresses the ratio): the 2x leg runs iters*m extra
+    # matmuls of KNOWN cost. An honest clock's elapsed delta must be at
+    # least that work at the chip's peak; a delta implying >peak FLOPs/s
+    # means the sync acked before the device finished. Constant round-trip
+    # cost cancels in the subtraction.
+    extra_flops = iters * m * 2 * size**3
+    delta = times[1] - times[0]
+    implied = extra_flops / max(delta, 1e-9)
+    kind = jax.devices()[0].device_kind
+    peak = next(
+        (v for k, v in _PEAK_FLOPS.items() if k.lower() in kind.lower()),
+        None,
+    )
+    # 1.25x headroom over peak absorbs timer jitter on the known chip;
+    # unknown chips fall back to the global plausibility ceiling
+    ceiling = peak * 1.25 if peak else _SANITY_FLOPS_CEILING
+    return {
+        "t_1x": round(times[0], 4),
+        "t_2x": round(times[1], 4),
+        "extra_work_tflop": round(extra_flops / 1e12, 1),
+        "implied_flops_per_s": float(f"{implied:.3g}"),
+        "ceiling_flops_per_s": float(f"{ceiling:.3g}"),
+        "ok": bool(delta > 0 and implied <= ceiling),
+    }
+
 
 def main(overrides: dict | None = None, emit: bool = True):
     o = overrides or {}
@@ -172,6 +238,16 @@ def main(overrides: dict | None = None, emit: bool = True):
         jax.device_get(loss)
     else:
         jax.block_until_ready(state.params)
+
+    # validate the clock itself before trusting the timed region with it
+    # (once per process; the flagship run reuses first_light's verdict)
+    if (
+        os.environ.get("AF2TPU_BENCH_CLOCK_CHECK", "1") != "0"
+        and jax.devices()[0].platform != "cpu"
+        and _CLOCK["probe"] is None
+    ):
+        _PHASE["name"] = phase_prefix + "clock_probe"
+        _CLOCK["probe"] = _clock_probe()
 
     _PHASE["name"] = phase_prefix + "timed_run"
     t0 = time.perf_counter()
@@ -249,6 +325,26 @@ def main(overrides: dict | None = None, emit: bool = True):
             "implausible.",
             file=sys.stderr,
         )
+    if _CLOCK["probe"] is not None:
+        record["clock_probe"] = _CLOCK["probe"]
+        if not _CLOCK["probe"]["ok"]:
+            # sub-peak inflation the >100%-MFU guard cannot see: the extra
+            # in-graph work's elapsed delta implies more than peak FLOPs/s,
+            # so the sync is not tracking device completion (ADVICE r4)
+            record["clock_suspect"] = True
+            print(
+                "WARNING: clock probe failed (known extra work implies "
+                f"{_CLOCK['probe']['implied_flops_per_s']:.3g} FLOP/s > "
+                f"ceiling {_CLOCK['probe']['ceiling_flops_per_s']:.3g}) — "
+                "timing does not track device completion. Record marked "
+                "clock_suspect.",
+                file=sys.stderr,
+            )
+    if record.get("implausible") or record.get("clock_suspect"):
+        # enforce the flag structurally (ADVICE r4): any consumer that
+        # ignores the marker keys must still see "no valid comparison"
+        record["vs_baseline"] = 0.0
+        record["vs_baseline_valid"] = False
     if not overrides and _FIRST_LIGHT["record"] is not None:
         # evidence trail: the flagship line carries its first-light result
         fl = _FIRST_LIGHT["record"]

@@ -86,9 +86,89 @@ def _flush():
             json.dump(RESULTS, f, indent=2)
 
 
+# Stages that touch the (possibly tunneled) jax backend. After any backend
+# death signature, each of these gets a CHEAP subprocess liveness probe
+# (seconds, not the 1500-2400s stage deadline) before it is allowed to run
+# — round 4 burned ~1.5 h of window on four stages against a dead tunnel
+# (VERDICT r4 #1b). train_real's HOST-SIDE half (shard provisioning) still
+# runs on fast-fail — see the train_real branch in _stage.
+_JAX_STAGES = frozenset(
+    ["first_light", "bench", "baseline", "pallas", "profile", "bisect",
+     "train_real", "capacity", "suite"]
+)
+_BACKEND = {"suspect": False}
+_DEATH_SIGNATURES = (
+    "Unable to initialize backend",
+    "stage deadline",
+    "hung tunnel",
+    "backend init never returned",
+    "UNAVAILABLE",
+)
+
+
+def _backend_probe(
+    timeout: int | None = None, env: dict | None = None
+) -> tuple[bool, str]:
+    """One tiny jax computation in a subprocess, hard-bounded. True iff the
+    backend completes it. Cheap when the relay answers (~seconds); a hung
+    tunnel costs `timeout`, not a stage deadline. The child inherits this
+    process's environment (including the axon site hook) by default, so it
+    probes the same backend the stages would use; ``env`` overrides for
+    tests."""
+    import subprocess
+
+    timeout = timeout or int(os.environ.get("AF2TPU_LIVENESS_TIMEOUT", 120))
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; "
+             "assert float(jnp.ones((8, 8)).sum()) == 64.0"],
+            timeout=timeout, capture_output=True, text=True, env=env,
+        )
+        if r.returncode == 0:
+            return True, "probe ok"
+        return False, f"probe rc={r.returncode}: {r.stderr[-300:]}"
+    except subprocess.TimeoutExpired:
+        return False, f"probe hung >{timeout}s (dead tunnel)"
+
+
+def _stage_failure_marks_backend(name: str) -> None:
+    rec = RESULTS["stages"].get(name, {})
+    err = str(rec.get("error", ""))
+    if any(s in err for s in _DEATH_SIGNATURES):
+        _BACKEND["suspect"] = True
+
+
 def _stage(name, fn):
     print(f"=== stage: {name} ===", flush=True)
     t0 = time.monotonic()
+    if (
+        _BACKEND["suspect"]
+        and name in _JAX_STAGES
+        and os.environ.get("AF2TPU_NO_LIVENESS_PROBE") != "1"
+    ):
+        alive, why = _backend_probe()
+        if not alive:
+            rec = {
+                "ok": False,
+                "seconds": round(time.monotonic() - t0, 1),
+                "error": f"fast-failed: backend liveness {why} "
+                "(a prior stage hit a backend death signature)",
+                "fast_failed": True,
+            }
+            if name == "train_real":
+                # the stage's shard provisioning is host-side and must not
+                # die with the tunnel: do it NOW so the next window trains
+                # immediately instead of re-discovering an empty cache dir
+                try:
+                    rec["shards_provisioned"] = ensure_real_shards()
+                except Exception as e:
+                    rec["provision_error"] = f"{type(e).__name__}: {e}"
+            RESULTS["stages"][name] = rec
+            print(f"stage {name} fast-failed: {why}", flush=True)
+            _flush()
+            return
+        _BACKEND["suspect"] = False  # tunnel came back; resume normally
     _CURRENT["stage"], _CURRENT["start"] = name, t0
     try:
         out = fn()
@@ -104,6 +184,7 @@ def _stage(name, fn):
         }
         print(f"stage {name} FAILED: {e}", flush=True)
     _CURRENT["stage"] = None
+    _stage_failure_marks_backend(name)
     _flush()
 
 
@@ -155,11 +236,11 @@ def stage_baseline():
         return "skipped (--no-rebaseline)"
     if not bench_res.get("ok") or not rec.get("value"):
         raise RuntimeError("no flagship bench measurement to record")
-    if rec.get("implausible"):
+    if rec.get("implausible") or rec.get("clock_suspect"):
         raise RuntimeError(
-            "refusing to record an implausible (> peak FLOPs) measurement "
-            "as the baseline — the timed region did not sync with device "
-            "completion"
+            "refusing to record an implausible or clock-suspect "
+            "measurement as the baseline — the timed region did not sync "
+            "with device completion"
         )
     if jax.devices()[0].platform == "cpu":
         raise RuntimeError("refusing to record a CPU run as the TPU baseline")
@@ -222,26 +303,39 @@ def stage_pallas():
     import numpy as np
 
     from alphafold2_tpu.ops.sparse import (
-        BlockSparseConfig, block_sparse_attention,
+        block_sparse_attention,
         block_sparse_attention_pallas,
     )
 
     if jax.devices()[0].platform == "cpu":
         raise RuntimeError("pallas stage needs the real chip (compiled mode)")
 
-    out = {}
-    for n, bs in ((512, 128), (1024, 128)):
-        cfg = BlockSparseConfig(
-            block_size=bs, num_local_blocks=4, num_global_blocks=1,
-            num_random_blocks=None,  # reference default seq/block/4
+    # pre-hardware lowering gate (VERDICT r4 #2): the full Mosaic lowering
+    # runs host-side in a scrubbed subprocess in ~1 min; a tiling/layout
+    # violation fails HERE instead of wasting the chip window on a compile
+    # that cannot succeed (round 4 lost its one pallas slot exactly so)
+    import subprocess
+
+    gate = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_tpu_lowering.py")],
+        capture_output=True, text=True, timeout=1200,
+    )
+    if gate.returncode != 0:
+        raise RuntimeError(
+            "TPU lowering gate failed — compiled run would die in Mosaic "
+            f"lowering; fix host-side first:\n{gate.stdout[-1500:]}"
+            f"\nstderr: {gate.stderr[-1000:]}"
         )
-        layout = cfg.layout(n)
-        k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
-        shape = (1, 4, n, 64)
-        q = jax.random.normal(k1, shape, jnp.float32)
-        k = jax.random.normal(k2, shape, jnp.float32)
-        v = jax.random.normal(k3, shape, jnp.float32)
-        mask = jnp.ones((1, n), bool).at[:, -17:].set(False)
+
+    out = {"lowering_gate": "passed"}
+    # the gate's input-builder IS this stage's configuration — one source
+    # of truth, so what the gate certifies host-side is exactly what runs
+    # here (import is safe: the gate's env scrub only fires as __main__)
+    from check_tpu_lowering import _sparse_inputs
+
+    for n, bs in ((512, 128), (1024, 128)):
+        q, k, v, layout, mask = _sparse_inputs(n, bs)
 
         ref = block_sparse_attention(q, k, v, layout, bs, mask=mask)
         pal = jax.jit(
@@ -319,6 +413,61 @@ def stage_pallas():
     return out
 
 
+def ensure_real_shards() -> str:
+    """HOST-SIDE shard provisioning for train_real — no TPU backend needed
+    (VERDICT r4 #1c: round 4's train_real slot died instantly on an empty
+    cache dir when the shards were buildable host-side the whole time).
+    Returns the shard directory; raises only if nothing can be imported.
+
+    Runs even when the backend is dead (the liveness fast-fail path calls
+    it), so the NEXT window always finds shards waiting."""
+    import shutil
+
+    shard_dir = os.environ.get(
+        "AF2TPU_REAL_SHARDS",
+        os.path.join(alphafold2_tpu.user_cache_dir(), "real_shards"),
+    )
+    pdb_dir = os.environ.get("AF2TPU_REAL_PDB_DIR")
+    have_shards = os.path.isdir(shard_dir) and any(
+        f.endswith(".npz") for f in os.listdir(shard_dir)
+    )
+    if have_shards:
+        return shard_dir
+    if not pdb_dir:
+        # default to the curated real-structure corpus — the reference's
+        # own PDB fixtures, minus the save_to_check* duplicates (same
+        # 482-res chain as 1h22_chain_1 rigid-transformed; training on
+        # them would triple-weight one chain — BASELINE.md r3 provenance)
+        curated = [
+            "/root/reference/notebooks/data/1h22_protein.pdb",
+            "/root/reference/notebooks/data/1h22_protein_chain_1.pdb",
+            "/root/reference/notebooks/data/4k77_protein.pdb",
+        ]
+        available = [p for p in curated if os.path.exists(p)]
+        if not available:
+            raise RuntimeError(
+                f"no .npz shards in {shard_dir}, no AF2TPU_REAL_PDB_DIR "
+                "set, and the reference PDB fixtures are absent — "
+                "nothing to train on"
+            )
+        pdb_dir = os.path.join(shard_dir, "_fixture_pdbs")
+        os.makedirs(pdb_dir, exist_ok=True)
+        for p in available:
+            dst = os.path.join(pdb_dir, os.path.basename(p))
+            if not os.path.exists(dst):
+                shutil.copy(p, dst)
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    mod = importlib.import_module("import_pdbs")
+    with _argv(pdb_dir, shard_dir):
+        rc = mod.main()
+    if rc:
+        raise RuntimeError(
+            f"import_pdbs failed (rc={rc}) for {pdb_dir}: no structures "
+            "imported"
+        )
+    return shard_dir
+
+
 def stage_train_real():
     """Flagship-dim training on REAL chains (VERDICT r1: quality evidence
     was toy-scale — dim 64): dim 256 / depth 2 / tied-row MSA on real PDB
@@ -337,29 +486,7 @@ def stage_train_real():
     import jax
     import jax.numpy as jnp
 
-    shard_dir = os.environ.get(
-        "AF2TPU_REAL_SHARDS",
-        os.path.join(alphafold2_tpu.user_cache_dir(), "real_shards"),
-    )
-    pdb_dir = os.environ.get("AF2TPU_REAL_PDB_DIR")
-    have_shards = os.path.isdir(shard_dir) and any(
-        f.endswith(".npz") for f in os.listdir(shard_dir)
-    )
-    if not have_shards:
-        if not pdb_dir:
-            raise RuntimeError(
-                f"no .npz shards in {shard_dir}: set AF2TPU_REAL_SHARDS to "
-                "a shard directory or AF2TPU_REAL_PDB_DIR to a directory "
-                "of .pdb files (imported via scripts/import_pdbs.py)"
-            )
-        mod = importlib.import_module("import_pdbs")
-        with _argv(pdb_dir, shard_dir):
-            rc = mod.main()
-        if rc:
-            raise RuntimeError(
-                f"import_pdbs failed (rc={rc}) for {pdb_dir}: no structures "
-                "imported"
-            )
+    shard_dir = ensure_real_shards()
 
     steps = int(os.environ.get("AF2TPU_TRAIN_REAL_STEPS", 2000))
     crop = int(os.environ.get("AF2TPU_TRAIN_REAL_CROP", 256))
@@ -593,6 +720,14 @@ def main():
     # short session deadline with nothing flushed.
     from alphafold2_tpu.preflight import preflight_compile_mode
 
+    # a relaunched session inherits the prior process's death evidence: its
+    # first jax stage must re-prove the (fresh) relay alive with the cheap
+    # probe instead of betting a stage deadline on it
+    for _rec in RESULTS["stages"].values():
+        if any(s in str(_rec.get("error", "")) for s in _DEATH_SIGNATURES):
+            _BACKEND["suspect"] = True
+            break
+
     RESULTS["preflight"] = preflight_compile_mode(
         # evaluated right before a re-exec, AFTER the probes have burned
         # their share of the budget
@@ -602,6 +737,10 @@ def main():
         ),
         deadline_env_var="AF2TPU_SESSION_DEADLINE",
     )
+    if RESULTS["preflight"] == "both_dead":
+        # don't bet stage deadlines on a tunnel both probes just failed;
+        # every jax stage now requires the cheap liveness probe to pass
+        _BACKEND["suspect"] = True
 
     requested = [a for a in sys.argv[1:] if not a.startswith("-")]
     names = requested or list(STAGES)
