@@ -102,9 +102,11 @@ for i in $(seq 1 "$CYCLES"); do
   fi
   if [ -n "$ok" ]; then
     log "[watch] tunnel healthy ($ok-compile) at $(date +%H:%M:%S); launching tpu_session $REMAINING"
+    # no AF2TPU_REAL_PDB_DIR default here: train_real self-provisions the
+    # CURATED fixture corpus (ensure_real_shards excludes the save_to_check
+    # duplicates, which the raw notebooks/data directory would include)
     AF2TPU_SESSION_DEADLINE=${AF2TPU_WATCH_SESSION_DEADLINE:-9000} \
       AF2TPU_SESSION_RESUME=1 \
-      AF2TPU_REAL_PDB_DIR=${AF2TPU_REAL_PDB_DIR:-/root/reference/notebooks/data} \
       python scripts/tpu_session.py $REMAINING ${FLAGS[@]+"${FLAGS[@]}"}
     log "[watch] session rc=$?"
     check_done && exit 0
