@@ -88,6 +88,27 @@ class DataConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Shape-bucketed batched inference (alphafold2_tpu/serve).
+
+    Sequence lengths are padded up a geometric bucket ladder so the number
+    of distinct compiled executables is bounded by ``len(buckets)`` instead
+    of the number of distinct request lengths; requests sharing a bucket are
+    batched up to ``max_batch`` with batch-dim padding (masked dummy slots)
+    so each bucket compiles exactly one (bucket, max_batch) executable."""
+
+    buckets: Tuple[int, ...] = (64, 96, 128, 192, 256)  # residues, ascending
+    max_batch: int = 4  # requests fused per dispatch (batch-dim padded)
+    # pad partial chunks up to max_batch: one executable per bucket (the
+    # serving default); False compiles one executable per seen chunk size
+    pad_batches: bool = True
+    msa_depth: int = 0  # synthesized MSA rows per request; 0 -> data.msa_depth
+    mds_iters: int = 200  # structure-realization Guttman iterations
+    donate_buffers: bool = True  # donate per-request feature buffers to XLA
+    return_distogram: bool = False  # ship (3L,3L,K) logits back per request
+
+
+@dataclass
 class TrainConfig:
     learning_rate: float = 3e-4  # train_pre.py:18
     num_steps: int = 100000  # train_pre.py:14 NUM_BATCHES
@@ -103,12 +124,22 @@ class TrainConfig:
     profile_steps: Tuple[int, int] = (10, 13)
 
 
+def _tuplify(section, name):
+    """JSON round-trips tuples as lists; restore the tuple type so configs
+    hash/compare consistently (executable-cache keys include buckets)."""
+    value = getattr(section, name)
+    if isinstance(value, list):
+        setattr(section, name, tuple(value))
+    return section
+
+
 @dataclass
 class Config:
     model: ModelConfig = field(default_factory=ModelConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     data: DataConfig = field(default_factory=DataConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)
@@ -120,7 +151,8 @@ class Config:
             model=ModelConfig(**raw.get("model", {})),
             mesh=MeshConfig(**raw.get("mesh", {})),
             data=DataConfig(**raw.get("data", {})),
-            train=TrainConfig(**raw.get("train", {})),
+            train=_tuplify(TrainConfig(**raw.get("train", {})), "profile_steps"),
+            serve=_tuplify(ServeConfig(**raw.get("serve", {})), "buckets"),
         )
 
     def apply_overrides(self, overrides: list[str]) -> "Config":
@@ -140,6 +172,9 @@ class Config:
                 parsed = int(value)
             elif isinstance(current, float):
                 parsed = float(value)
+            elif isinstance(current, tuple):
+                # comma-separated ints, e.g. --serve.buckets=64,128,256
+                parsed = tuple(int(v) for v in value.split(",") if v)
             else:
                 parsed = value
             setattr(section, field_name, parsed)

@@ -71,6 +71,42 @@ def _synthesize_backbone(rng: np.random.Generator, ca: np.ndarray) -> np.ndarray
     return bb.reshape(n * 3, 3).astype(np.float32)
 
 
+def featurize_bucketed(
+    seq_tokens: np.ndarray,  # (L,) int32 AA tokens
+    bucket_len: int,
+    msa_depth: int,
+    seed: int = 0,
+    msa_len: int | None = None,
+) -> dict:
+    """One inference request -> fixed-shape features at a bucket length.
+
+    The serve engine's featurizer: the sequence is padded up to
+    ``bucket_len`` with ``AA_PAD_INDEX`` + a validity mask, and an MSA is
+    synthesized by mutating the primary sequence (the same ``_fill_msa``
+    every training source uses) into ``(msa_depth, msa_len or bucket_len)``
+    padded rows. Returns an UNBATCHED item dict (``seq`` (bucket,), ``mask``,
+    ``msa``, ``msa_mask``) — the engine stacks items into its batch dim.
+    """
+    seq_tokens = np.asarray(seq_tokens, np.int32).reshape(-1)
+    L = len(seq_tokens)
+    if L > bucket_len:
+        raise ValueError(
+            f"sequence of {L} residues does not fit bucket {bucket_len}"
+        )
+    NM = msa_len or bucket_len
+    rng = np.random.default_rng(seed)
+    item = {
+        "seq": np.full(bucket_len, constants.AA_PAD_INDEX, np.int32),
+        "mask": np.zeros(bucket_len, bool),
+        "msa": np.full((msa_depth, NM), constants.AA_PAD_INDEX, np.int32),
+        "msa_mask": np.zeros((msa_depth, NM), bool),
+    }
+    item["seq"][:L] = seq_tokens
+    item["mask"][:L] = True
+    _fill_msa(rng, seq_tokens, item["msa"], item["msa_mask"])
+    return item
+
+
 @dataclasses.dataclass
 class SyntheticDataset:
     """Deterministic synthetic chains; infinite iterator of fixed-shape batches."""

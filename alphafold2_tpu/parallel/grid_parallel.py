@@ -31,10 +31,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from alphafold2_tpu.ops.attention import MASK_VALUE
+from alphafold2_tpu.parallel.sharding import (
+    axis_size_compat,
+    shard_map_compat as shard_map,
+)
 
 DATA_AXIS_NAME = "dp"
 ROW_AXIS_NAME = "spr"  # shards grid axis 1 (rows / height)
@@ -113,7 +116,7 @@ def _sharded_pass(q, k, v, mask, attend_axis: int, attn_fn=None):
         gather_name, split_axis = ROW_AXIS_NAME, 2
     else:
         raise ValueError(f"attend_axis must be 1 or 2, got {attend_axis}")
-    size = lax.axis_size(gather_name)
+    size = axis_size_compat(gather_name)
     if q.shape[split_axis] % size:
         raise ValueError(
             f"non-attended local axis {q.shape[split_axis]} must divide by "

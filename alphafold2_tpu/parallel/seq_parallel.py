@@ -35,10 +35,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from alphafold2_tpu.ops.attention import MASK_VALUE
+from alphafold2_tpu.parallel.sharding import (
+    axis_size_compat,
+    shard_map_compat as shard_map,
+)
 
 SEQ_AXIS_NAME = "sp"
 DATA_AXIS_NAME = "dp"
@@ -66,7 +69,7 @@ def ring_attention(
     into (running_max, running_sum, accumulator); rotate KV one hop with
     ppermute. After ``sp`` steps every query block has seen every key.
     """
-    sp = lax.axis_size(axis_name)
+    sp = axis_size_compat(axis_name)
     scale = q.shape[-1] ** -0.5
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
@@ -108,7 +111,7 @@ def ulysses_attention(
 ) -> jnp.ndarray:
     """All-to-all sequence parallelism (Ulysses): re-shard seq -> heads,
     attend densely over the full sequence locally, re-shard back."""
-    sp = lax.axis_size(axis_name)
+    sp = axis_size_compat(axis_name)
     if q.shape[1] % sp != 0:
         raise ValueError(
             f"heads {q.shape[1]} must divide by sp={sp} for ulysses"

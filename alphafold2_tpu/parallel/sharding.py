@@ -35,6 +35,40 @@ SEQ_AXIS = "sp"
 _active: dict = {"mesh": None}
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across jax versions.
+
+    The public ``jax.shard_map`` (with its ``check_vma`` kwarg) only exists
+    on newer jax; older versions ship it as ``jax.experimental.shard_map``
+    where the same knob is spelled ``check_rep``. Every shard_map in this
+    package goes through here so a version bump is a one-line change."""
+    try:
+        from jax import shard_map as _sm
+
+        return _sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
+
+
+def axis_size_compat(axis_name: str):
+    """``lax.axis_size`` across jax versions: absent on older jax, where
+    ``psum(1, axis)`` is the idiomatic (constant-folded) equivalent."""
+    from jax import lax
+
+    try:
+        return lax.axis_size(axis_name)
+    except AttributeError:
+        return lax.psum(1, axis_name)
+
+
 def make_mesh(
     n_data: Optional[int] = None, n_seq: int = 1, devices=None
 ) -> Mesh:

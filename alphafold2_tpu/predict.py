@@ -39,6 +39,7 @@ def realize_structure(
     key: Optional[jax.Array] = None,
     fix_mirror: bool = True,
     mask: Optional[jnp.ndarray] = None,  # (B, N) bool token validity
+    per_position_init: bool = False,
 ):
     """Distogram logits -> (coords (B, 3, N), distances, weights).
 
@@ -47,16 +48,25 @@ def realize_structure(
     (N, CA, C)-elongated when ``fix_mirror`` (the chirality test reads
     backbone phi angles). ``mask`` zeroes the MDS weights of pairs touching
     padded positions so padding's arbitrary pseudo-distances cannot distort
-    the valid region."""
+    the valid region, and restricts the chirality statistic to valid
+    residues. ``per_position_init`` keys each position's MDS start by its
+    absolute index so the valid-region solve is reproducible across padded
+    bucket shapes (see utils/mds.py)."""
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     distances, weights = center_distogram(probs)
+    residue_mask = None
     if mask is not None:
         pair_valid = mask[:, :, None] & mask[:, None, :]
         weights = weights * pair_valid
+        if fix_mirror:
+            b, n = mask.shape
+            residue_mask = mask.reshape(b, n // 3, 3).any(-1)  # (B, L)
     coords, _ = mdscaling_backbone(
         distances, weights=weights, iters=iters,
         key=key if key is not None else jax.random.key(0),
         fix_mirror=fix_mirror,
+        residue_mask=residue_mask,
+        per_position_init=per_position_init,
     )
     return coords, distances, weights
 

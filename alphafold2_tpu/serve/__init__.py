@@ -1,0 +1,24 @@
+"""Serving layer: shape-bucketed, batched inference with compile accounting.
+
+See :mod:`alphafold2_tpu.serve.engine` (the engine) and
+:mod:`alphafold2_tpu.serve.bucketing` (the ladder math). Configured by
+``config.ServeConfig``; benched by ``bench.py --mode serve``.
+"""
+
+from alphafold2_tpu.serve.bucketing import (
+    bucket_for,
+    geometric_ladder,
+    padding_fraction,
+    validate_ladder,
+)
+from alphafold2_tpu.serve.engine import ServeEngine, ServeRequest, ServeResult
+
+__all__ = [
+    "ServeEngine",
+    "ServeRequest",
+    "ServeResult",
+    "bucket_for",
+    "geometric_ladder",
+    "padding_fraction",
+    "validate_ladder",
+]

@@ -1,0 +1,72 @@
+"""Shape-bucket ladder: bounded executable count under ragged request lengths.
+
+A fresh XLA program per distinct sequence length is the canonical serving
+anti-pattern — the compile (minutes at flagship sizes, even through the
+persistent cache) dwarfs the inference it serves. Instead, request lengths
+are padded UP to the nearest rung of a geometric ladder
+(``config.ServeConfig.buckets``): the number of executables is bounded by
+the ladder size, padding waste is bounded by the ladder's growth ratio, and
+everything downstream (trunk attention, distogram, MDS realization, SE(3)
+refinement) runs masked so the padding cannot leak into valid coordinates.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def validate_ladder(buckets: Sequence[int]) -> tuple:
+    """Normalize + sanity-check a bucket ladder (ascending unique ints)."""
+    if not buckets:
+        raise ValueError("bucket ladder is empty")
+    ladder = tuple(int(b) for b in buckets)
+    if any(b <= 0 for b in ladder):
+        raise ValueError(f"bucket lengths must be positive: {ladder}")
+    if list(ladder) != sorted(set(ladder)):
+        raise ValueError(
+            f"bucket ladder must be strictly ascending: {ladder}"
+        )
+    return ladder
+
+
+def bucket_for(length: int, buckets: Sequence[int]) -> int:
+    """Smallest ladder rung >= ``length`` (residues).
+
+    Raises ValueError when the request exceeds the top rung — the caller
+    decides whether that is a reject or a reason to extend the ladder.
+    """
+    if length <= 0:
+        raise ValueError(f"sequence length must be positive, got {length}")
+    for b in buckets:
+        if length <= b:
+            return int(b)
+    raise ValueError(
+        f"sequence of {length} residues exceeds the largest bucket "
+        f"{max(buckets)}; extend serve.buckets or reject the request"
+    )
+
+
+def geometric_ladder(lo: int, hi: int, ratio: float = 1.5) -> tuple:
+    """Build a ladder from ``lo`` up to (at least) ``hi`` growing by
+    ``ratio`` — the worst-case padded-compute overhead is ``ratio**2`` on
+    the N^2 pair grid, the executable count is log_ratio(hi/lo)."""
+    if lo <= 0 or hi < lo:
+        raise ValueError(f"need 0 < lo <= hi, got lo={lo} hi={hi}")
+    if ratio <= 1.0:
+        raise ValueError(f"ladder ratio must be > 1, got {ratio}")
+    out = [int(lo)]
+    while out[-1] < hi:
+        nxt = max(out[-1] + 1, int(round(out[-1] * ratio)))
+        out.append(min(nxt, int(hi)) if nxt >= hi else nxt)
+    return tuple(out)
+
+
+def padding_fraction(lengths: Sequence[int], buckets: Sequence[int]) -> float:
+    """Fraction of padded (wasted) positions a request mix incurs on this
+    ladder — an ops-facing planning metric (also in bench_serve records)."""
+    total = padded = 0
+    for n in lengths:
+        b = bucket_for(n, buckets)
+        total += b
+        padded += b - n
+    return padded / total if total else 0.0

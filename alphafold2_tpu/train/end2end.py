@@ -64,6 +64,9 @@ class End2EndModel(nn.Module):
     dim_head: int = 64
     max_seq_len: int = 2048
     mds_iters: int = 200
+    # position-keyed MDS init: valid-region realization independent of the
+    # padded bucket shape (serve engine turns this on; see utils/mds.py)
+    mds_per_position_init: bool = False
     refiner_depth: int = 2
     remat: bool = False
     remat_policy: "str | None" = None  # None/"nothing" | "dots" | "dots_no_batch"
@@ -99,10 +102,26 @@ class End2EndModel(nn.Module):
         coords, distances, weights = realize_structure(
             logits, iters=self.mds_iters,
             key=mds_key if mds_key is not None else jax.random.key(0),
+            # extend the token-validity mask through realization: pairs
+            # touching padded positions get weight 0 and the chirality
+            # statistic sees only valid residues, so padding (crop padding
+            # in training, bucket padding in serving) cannot distort the
+            # valid-region coordinates
+            mask=mask3,
+            per_position_init=self.mds_per_position_init,
         )  # coords (B, 3, 3L)
 
         backbone = jnp.swapaxes(coords, -1, -2)  # (B, 3L, 3)
-        proto = sidechain_container(backbone, place_oxygen=True)  # (B, L, 14, 3)
+        proto = sidechain_container(
+            backbone, place_oxygen=True, mask=mask
+        )  # (B, L, 14, 3)
+        if mask is not None:
+            # park padded residues' atoms at the origin: the refiner's
+            # geometry (pairwise distances -> RBF logits) must see a value
+            # that is finite and independent of whatever the padded MDS/NeRF
+            # positions happened to be — additive attention masking removes
+            # their influence on logits, not NaN/garbage in them
+            proto = jnp.where(mask[:, :, None, None], proto, 0.0)
 
         atom_tokens = jnp.broadcast_to(
             jnp.arange(constants.NUM_COORDS_PER_RES)[None, None],

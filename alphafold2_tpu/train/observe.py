@@ -43,6 +43,31 @@ class MetricsLogger:
                 f.write(line + "\n")
 
 
+class EventCounters:
+    """Named monotonic counters for process-local accounting (compile
+    counts, cache hits, request totals). Same spirit as MetricsLogger but
+    for events without a step axis: ``bump`` from anywhere, ``snapshot``
+    into a record, ``log_to`` to emit through a MetricsLogger. The serve
+    engine's compile-count/cache-hit instrumentation is built on this so
+    tests can assert exact executable-cache behavior."""
+
+    def __init__(self):
+        self._counts: dict = {}
+
+    def bump(self, name: str, n: int = 1) -> int:
+        self._counts[name] = self._counts.get(name, 0) + n
+        return self._counts[name]
+
+    def get(self, name: str) -> int:
+        return self._counts.get(name, 0)
+
+    def snapshot(self) -> dict:
+        return dict(self._counts)
+
+    def log_to(self, logger: "MetricsLogger", step: int = 0) -> None:
+        logger.log(step, self.snapshot())
+
+
 class Profiler:
     """Start/stop a jax profiler trace across a [start, stop) step window."""
 

@@ -38,22 +38,56 @@ def mds(
     iters: int = 10,
     tol: float = 1e-5,
     key: jax.Array | None = None,
+    per_position_init: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Weighted metric MDS via iterative Guttman transform.
 
     pre_dist_mat: (B, N, N) or (N, N) target distances; weights same shape.
     Returns (coords (B, 3, N), stress_history (iters, B)).
+
+    Padding-aware: the Guttman step divides by the number of *participating*
+    points (positions with any positive weight) per batch element, not the
+    padded array size, so zero-weighting the pairs that touch padded
+    positions makes the valid-region iteration independent of how much
+    padding the shape carries. Convergence (``done``) is tracked per batch
+    element — co-batched elements cannot freeze or extend each other's
+    iterations, which batched serving's solo-vs-batched parity requires.
+
+    ``per_position_init``: derive each position's random start from
+    ``fold_in(key, position)`` instead of one draw over the whole (B, N, 3)
+    block. The init then depends only on the absolute position index — the
+    same residue gets the same start whatever bucket shape or batch slot it
+    is served in (the shape-bucketed engine turns this on for
+    reproducibility across bucket/batch padding).
     """
     if key is None:
         key = jax.random.key(0)
     pre_dist_mat = jnp.asarray(pre_dist_mat)
     if pre_dist_mat.ndim == 2:
         pre_dist_mat = pre_dist_mat[None]
-    if weights is None:
-        weights = jnp.ones_like(pre_dist_mat)
     batch, N, _ = pre_dist_mat.shape
 
-    coords0 = 2.0 * jax.random.uniform(key, (batch, N, 3), pre_dist_mat.dtype) - 1.0
+    if per_position_init:
+        pos = jnp.arange(N)
+        draw = jax.vmap(
+            lambda i: jax.random.uniform(
+                jax.random.fold_in(key, i), (3,), pre_dist_mat.dtype
+            )
+        )(pos)  # (N, 3), independent of batch/bucket shape
+        coords0 = jnp.broadcast_to(2.0 * draw - 1.0, (batch, N, 3))
+    else:
+        coords0 = (
+            2.0 * jax.random.uniform(key, (batch, N, 3), pre_dist_mat.dtype)
+            - 1.0
+        )
+    if weights is None:
+        weights = jnp.ones_like(pre_dist_mat)
+        n_eff = jnp.full((batch,), float(N), pre_dist_mat.dtype)
+    else:
+        participating = jnp.any(weights > 0, axis=-1)  # (B, N)
+        n_eff = jnp.maximum(
+            jnp.sum(participating, axis=-1).astype(pre_dist_mat.dtype), 1.0
+        )
     diag = jnp.eye(N, dtype=pre_dist_mat.dtype)
 
     def step(carry, _):
@@ -63,20 +97,20 @@ def mds(
         dist_mat = jnp.where(dist_mat == 0.0, 1e-7, dist_mat)
         ratio = weights * (pre_dist_mat / dist_mat)
         B = -ratio + diag * jnp.sum(ratio, axis=-1, keepdims=True)
-        new_coords = jnp.einsum("bij,bjd->bid", B, coords) / N
+        new_coords = jnp.einsum("bij,bjd->bid", B, coords) / n_eff[:, None, None]
         dis = jnp.linalg.norm(new_coords, axis=(-1, -2))
         rel_stress = stress / dis
-        # converged when mean relative improvement drops below tol
-        improved = jnp.mean(best_stress - rel_stress) > tol
-        done = done | ~improved
-        coords = jnp.where(done, coords, new_coords)
+        # converged when the element's relative improvement drops below tol
+        improved = (best_stress - rel_stress) > tol
+        done = done | ~improved  # (B,)
+        coords = jnp.where(done[:, None, None], coords, new_coords)
         best_stress = jnp.where(done, best_stress, rel_stress)
         return (coords, best_stress, done), rel_stress
 
     init = (
         coords0,
         jnp.full((batch,), jnp.inf, pre_dist_mat.dtype),
-        jnp.asarray(False),
+        jnp.zeros((batch,), bool),
     )
     (coords, _, _), history = jax.lax.scan(step, init, None, length=iters)
     return jnp.swapaxes(coords, -1, -2), history
@@ -113,20 +147,36 @@ def mdscaling(
     return _flip_mirrors(preds, phi_ratios), stresses
 
 
-def calc_phis_backbone(coords: jnp.ndarray, prop: bool = True) -> jnp.ndarray:
+def calc_phis_backbone(
+    coords: jnp.ndarray,
+    prop: bool = True,
+    mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
     """Phi angles assuming the flat stream is (N, CA, C) repeating (l_aa=3).
 
     coords: (B, 3, L*3). Static reshape instead of boolean gathers -> traceable
     under jit, for use inside a compiled end-to-end train step.
+
+    ``mask``: optional (B, L) residue validity. Padded residues sit at
+    degenerate (zeroed) coordinates whose dihedrals are meaningless; with a
+    mask, the negative-phi ratio averages only transitions where both
+    flanking residues are valid, so padding cannot skew the chirality
+    decision toward a spurious mirror flip.
     """
     coords = jnp.swapaxes(jax.lax.stop_gradient(coords), -1, -2)  # (B, 3L, 3)
     b, flat, _ = coords.shape
     res = coords.reshape(b, flat // 3, 3, 3)  # (B, L, atom, 3)
     n, ca, c = res[:, :, 0], res[:, :, 1], res[:, :, 2]
     phis = get_dihedral(c[:, :-1], n[:, 1:], ca[:, 1:], c[:, 1:])
-    if prop:
-        return jnp.mean((phis < 0).astype(jnp.float32), axis=-1)
-    return phis
+    if not prop:
+        return phis
+    neg = (phis < 0).astype(jnp.float32)
+    if mask is None:
+        return jnp.mean(neg, axis=-1)
+    valid = (mask[:, :-1] & mask[:, 1:]).astype(jnp.float32)  # (B, L-1)
+    return jnp.sum(neg * valid, axis=-1) / jnp.maximum(
+        jnp.sum(valid, axis=-1), 1.0
+    )
 
 
 def mdscaling_backbone(
@@ -136,12 +186,21 @@ def mdscaling_backbone(
     tol: float = 1e-5,
     fix_mirror: bool = True,
     key: jax.Array | None = None,
+    residue_mask: jnp.ndarray | None = None,
+    per_position_init: bool = False,
 ):
-    """Jit-compatible MDScaling for (N, CA, C)-elongated backbone streams."""
-    preds, stresses = mds(pre_dist_mat, weights=weights, iters=iters, tol=tol, key=key)
+    """Jit-compatible MDScaling for (N, CA, C)-elongated backbone streams.
+
+    ``residue_mask``: (B, L) validity over residues (NOT the 3L atom
+    stream) restricting the chirality statistic to real residues.
+    """
+    preds, stresses = mds(
+        pre_dist_mat, weights=weights, iters=iters, tol=tol, key=key,
+        per_position_init=per_position_init,
+    )
     if not fix_mirror:
         return preds, stresses
-    phi_ratios = calc_phis_backbone(preds, prop=True)
+    phi_ratios = calc_phis_backbone(preds, prop=True, mask=residue_mask)
     return _flip_mirrors(preds, phi_ratios), stresses
 
 

@@ -178,7 +178,12 @@ def nerf(
     n_plane = jnp.cross(ba, cb)
     n_plane_ = jnp.cross(n_plane, cb)
     rotate = jnp.stack([cb, n_plane_, n_plane], axis=-1)
-    rotate = rotate / jnp.linalg.norm(rotate, axis=-2, keepdims=True)
+    # guarded normalization: degenerate frames (coincident a/b/c — e.g.
+    # padded residues parked at the origin) must yield a finite placement,
+    # not a 0/0 NaN that additive attention masks downstream cannot stop
+    rotate = rotate / jnp.maximum(
+        jnp.linalg.norm(rotate, axis=-2, keepdims=True), 1e-8
+    )
     d = jnp.stack(
         [
             -jnp.cos(theta),
@@ -195,6 +200,7 @@ def sidechain_container(
     place_oxygen: bool = False,
     n_atoms: int = constants.NUM_COORDS_PER_RES,
     padding: float = constants.GLOBAL_PAD_CHAR,
+    mask: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Lift a (B, L*3, 3) backbone (N, CA, C per residue) to (B, L, 14, 3).
 
@@ -203,6 +209,12 @@ def sidechain_container(
     default to CA copies. Differentiable. Matches reference utils.py:228-263
     but vectorizes the per-residue psi/NeRF loop (utils.py:249-262) into one
     batched NeRF call.
+
+    ``mask``: optional (B, L) residue validity. The psi dihedral reads the
+    NEXT residue's N; without a mask, the last *valid* residue of a padded
+    chain would read a padded pseudo-atom instead of getting the fixed
+    last-residue psi (5pi/4) — its oxygen would then depend on how much
+    padding the shape carries.
     """
     batch, length = backbones.shape[0], backbones.shape[1] // 3
     bb = backbones.reshape(batch, length, 3, 3)
@@ -216,9 +228,16 @@ def sidechain_container(
         n_i, ca_i, c_i = bb[:, :, 0], bb[:, :, 1], bb[:, :, 2]
         n_next = jnp.concatenate([n_i[:, 1:], jnp.zeros_like(n_i[:, :1])], axis=1)
         psis = get_dihedral(n_i, ca_i, c_i, n_next)  # (B, L)
-        # psi undefined for the last residue; reference uses 5pi/4 (utils.py:252)
-        last = jnp.arange(length) == length - 1
-        psis = jnp.where(last[None, :], np.pi * 5 / 4, psis)
+        # psi undefined where no valid next residue exists: the stream's
+        # final residue, and (under a mask) every chain-terminal residue;
+        # reference uses 5pi/4 there (utils.py:252)
+        no_next = (jnp.arange(length) == length - 1)[None, :]
+        if mask is not None:
+            next_valid = jnp.concatenate(
+                [mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1
+            )
+            no_next = no_next | ~next_valid
+        psis = jnp.where(no_next, np.pi * 5 / 4, psis)
 
         bond_len = jnp.full((batch, length), constants.BB_BUILD_INFO["BONDLENS"]["c-o"])
         bond_ang = jnp.full((batch, length), constants.BB_BUILD_INFO["BONDANGS"]["ca-c-o"])

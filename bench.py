@@ -61,6 +61,7 @@ _INFRA_KNOBS = {
     "AF2TPU_BENCH_COLD_EXTRA", "AF2TPU_BENCH_DRIVER_BUDGET",
     "AF2TPU_BENCH_EPOCH0",  # wall-clock anchor set by __main__ itself
     "AF2TPU_BENCH_FIRST_LIGHT",  # fallback policy, not a config size
+    "AF2TPU_BENCH_MODE",  # train vs serve routing, not a config size
 }
 
 
@@ -358,6 +359,199 @@ def main(overrides: dict | None = None, emit: bool = True):
     return record
 
 
+# ------------------------------------------------------------------ serve ---
+
+# AF2TPU_SERVE_* knobs (NOT AF2TPU_BENCH_*: they must not trip the flagship
+# train bench's config_overridden detection). Any of these set -> the serve
+# record is a non-flagship config and is never compared to the committed
+# serve baseline.
+_SERVE_INFRA_KNOBS = {"AF2TPU_SERVE_RECORD_BASELINE"}
+
+
+def serve_config_overridden() -> bool:
+    return any(
+        k.startswith("AF2TPU_SERVE_") and k not in _SERVE_INFRA_KNOBS
+        for k in os.environ
+    )
+
+
+def _serve_sizes() -> dict:
+    """The serve-bench flagship config; CPU-mesh sized so tier-1 hosts give
+    real (nonzero, clock-honest) numbers — the first valid perf points of
+    the trajectory. TPU-scale serving reuses the same engine with bigger
+    AF2TPU_SERVE_* values once the tunnel is back."""
+    buckets = tuple(
+        int(v) for v in os.environ.get(
+            "AF2TPU_SERVE_BUCKETS", "32,48,64"
+        ).split(",") if v
+    )
+    return {
+        "buckets": buckets,
+        "max_batch": _env_int("AF2TPU_SERVE_MAX_BATCH", 4),
+        "requests": _env_int("AF2TPU_SERVE_REQUESTS", 24),
+        "dim": _env_int("AF2TPU_SERVE_DIM", 64),
+        "depth": _env_int("AF2TPU_SERVE_DEPTH", 2),
+        "heads": _env_int("AF2TPU_SERVE_HEADS", 4),
+        "dim_head": _env_int("AF2TPU_SERVE_DIM_HEAD", 16),
+        "msa_depth": _env_int("AF2TPU_SERVE_MSA_DEPTH", 4),
+        "mds_iters": _env_int("AF2TPU_SERVE_MDS_ITERS", 50),
+        "seed": _env_int("AF2TPU_SERVE_SEED", 0),
+    }
+
+
+def _serve_metric(s: dict) -> str:
+    return (
+        f"serve residues/sec buckets={','.join(map(str, s['buckets']))} "
+        f"max_batch={s['max_batch']} requests={s['requests']} "
+        f"dim={s['dim']} depth={s['depth']} msa_depth={s['msa_depth']} "
+        f"mds_iters={s['mds_iters']}"
+    )
+
+
+def bench_serve(emit: bool = True) -> dict:
+    """Serving throughput/latency on the bucketed batched engine.
+
+    Measures a mixed-length request stream end to end: residues/sec over
+    the whole stream plus p50/p95 per-request latency (the wall time of the
+    dispatch that carried the request — what a caller observes). Compiles
+    happen in an explicit warmup and are reported separately; the timed
+    region closes on jax.device_get of the output coordinates, so the
+    numbers are real completions, not dispatch acks (clock-probe-checked on
+    non-CPU backends like the main bench)."""
+    import numpy as np
+
+    from alphafold2_tpu.config import (
+        Config, DataConfig, ModelConfig, ServeConfig,
+    )
+    from alphafold2_tpu.serve import ServeEngine, ServeRequest, padding_fraction
+
+    s = _serve_sizes()
+    _PHASE["name"] = "serve:backend_init"
+    cfg = Config(
+        model=ModelConfig(
+            dim=s["dim"], depth=s["depth"], heads=s["heads"],
+            dim_head=s["dim_head"], max_seq_len=3 * s["buckets"][-1],
+            bfloat16=jax.devices()[0].platform != "cpu",
+        ),
+        data=DataConfig(msa_depth=s["msa_depth"]),
+        serve=ServeConfig(
+            buckets=s["buckets"], max_batch=s["max_batch"],
+            mds_iters=s["mds_iters"],
+        ),
+    )
+    engine = ServeEngine(cfg)
+
+    # deterministic mixed-length request stream spanning the ladder
+    rng = np.random.default_rng(s["seed"])
+    lo = max(4, s["buckets"][0] // 2)
+    lengths = rng.integers(lo, s["buckets"][-1] + 1, size=s["requests"])
+    alpha = "ACDEFGHIKLMNPQRSTVWY"
+    reqs = [
+        ServeRequest(
+            seq="".join(rng.choice(list(alpha), size=int(n))), seed=i
+        )
+        for i, n in enumerate(lengths)
+    ]
+
+    _PHASE["name"] = "serve:trace_compile"
+    t0 = time.perf_counter()
+    engine.warmup()  # one executable per ladder rung, counted
+    compile_s = time.perf_counter() - t0
+
+    if (
+        os.environ.get("AF2TPU_BENCH_CLOCK_CHECK", "1") != "0"
+        and jax.devices()[0].platform != "cpu"
+        and _CLOCK["probe"] is None
+    ):
+        _PHASE["name"] = "serve:clock_probe"
+        _CLOCK["probe"] = _clock_probe()
+
+    _PHASE["name"] = "serve:timed_run"
+    t0 = time.perf_counter()
+    results = engine.predict_many(reqs)
+    wall = time.perf_counter() - t0
+    _PHASE["name"] = "serve:record"
+
+    total_residues = int(sum(len(r.seq) for r in reqs))
+    lat_ms = sorted(1e3 * r.latency_s for r in results)
+    p50 = lat_ms[len(lat_ms) // 2]
+    p95 = lat_ms[min(len(lat_ms) - 1, int(0.95 * len(lat_ms)))]
+    stats = engine.stats()
+
+    record = {
+        "metric": _serve_metric(s),
+        "value": round(total_residues / wall, 1),
+        "unit": "residues/sec",
+        "mode": "serve",
+        "p50_ms": round(p50, 1),
+        "p95_ms": round(p95, 1),
+        "compile_s": round(compile_s, 1),
+        "compiles": stats.get("serve.compiles", 0),
+        "cache_hits": stats.get("serve.cache_hits", 0),
+        "requests": stats.get("serve.requests", 0),
+        "batches": stats.get("serve.batches", 0),
+        "padding_fraction": round(
+            padding_fraction([len(r.seq) for r in reqs], s["buckets"]), 3
+        ),
+        "device": jax.devices()[0].device_kind,
+    }
+    if _CLOCK["probe"] is not None:
+        record["clock_probe"] = _CLOCK["probe"]
+        if not _CLOCK["probe"]["ok"]:
+            record["clock_suspect"] = True
+
+    # the serve trajectory competes against its own committed first record,
+    # like the train bench; comparisons require the identical metric label
+    # AND device (a CPU-mesh number vs a TPU number is not a comparison)
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_serve_baseline.json"
+    )
+    vs, compared = 1.0, False
+    if (
+        os.path.exists(baseline_path)
+        and not serve_config_overridden()
+        and not record.get("clock_suspect")
+    ):
+        with open(baseline_path) as f:
+            base = json.load(f)
+        if (
+            base.get("value")
+            and base.get("metric") == record["metric"]
+            and base.get("device") == record["device"]
+        ):
+            vs = record["value"] / base["value"]
+            compared = True
+    record["vs_baseline"] = round(vs, 3)
+    record["vs_baseline_valid"] = compared and not record.get("clock_suspect")
+    if record.get("clock_suspect"):
+        record["vs_baseline"] = 0.0
+
+    if (
+        os.environ.get("AF2TPU_SERVE_RECORD_BASELINE") == "1"
+        and not serve_config_overridden()
+        and not record.get("clock_suspect")
+    ):
+        with open(baseline_path, "w") as f:
+            json.dump(record, f, indent=2)
+        print(f"recorded serve baseline -> {baseline_path}", file=sys.stderr)
+
+    if emit:
+        _emit(record)
+    return record
+
+
+def bench_mode(argv=None) -> str:
+    """The bench mode: 'train' (default flagship step bench) or 'serve'.
+    Spelled ``--mode serve`` / ``--mode=serve`` or AF2TPU_BENCH_MODE."""
+    args = sys.argv[1:] if argv is None else argv
+    for i, a in enumerate(args):
+        if a == "--mode" and i + 1 < len(args):
+            return args[i + 1]
+        if a.startswith("--mode="):
+            return a.split("=", 1)[1]
+    return os.environ.get("AF2TPU_BENCH_MODE", "train")
+
+
 # published peak dense bf16 FLOPs/s per chip (v5e's oft-quoted 394 is int8)
 _PEAK_FLOPS = {
     "TPU v4": 275e12,
@@ -567,6 +761,18 @@ if __name__ == "__main__":
     # be able to outlive a short driver-set deadline with nothing on stdout
     if DEADLINE > 0:
         threading.Thread(target=_watchdog, daemon=True).start()
+
+    if bench_mode() == "serve":
+        # the serve bench runs wherever the engine runs (the CPU mesh
+        # included — that is the point: valid perf numbers without the
+        # tunnel); no preflight, no first-light, same watchdog + one-JSON-
+        # line contract as the train bench
+        try:
+            bench_serve()
+            sys.exit(0)
+        except Exception as e:
+            _emit_failure(f"{type(e).__name__}: {e}")
+            raise
 
     preflight_status = _preflight_compile_mode()
     DEADLINE += _cold_cache_deadline_extension(preflight_status)

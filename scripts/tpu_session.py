@@ -331,7 +331,10 @@ def stage_pallas():
     out = {"lowering_gate": "passed"}
     # the gate's input-builder IS this stage's configuration — one source
     # of truth, so what the gate certifies host-side is exactly what runs
-    # here (import is safe: the gate's env scrub only fires as __main__)
+    # here (import is safe: the gate's env scrub only fires as __main__).
+    # scripts/ on sys.path like ensure_real_shards does it: the import must
+    # also resolve when tpu_session is imported from outside scripts/
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
     from check_tpu_lowering import _sparse_inputs
 
     for n, bs in ((512, 128), (1024, 128)):

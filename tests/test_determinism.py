@@ -3,6 +3,7 @@ machinery is RNG capture/replay for reversible recompute; here determinism
 is end-to-end by construction (stateless PRNG keys, deterministic data
 seeds, ordered native prefetch) — and these tests pin it."""
 
+import pytest
 import jax
 import numpy as np
 
@@ -41,6 +42,7 @@ def _run(n_steps=3):
     return losses, state
 
 
+@pytest.mark.slow
 def test_training_run_bitwise_repeatable():
     # dropout active (attn+ff 0.1), real data stream: two runs from the same
     # seeds must produce bit-identical loss trajectories and final params

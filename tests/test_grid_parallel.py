@@ -85,6 +85,7 @@ def test_spr_only_grid():
     np.testing.assert_allclose(np.asarray(sharded), np.asarray(dense), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_grid_train_step_matches_single_device():
     """Full training step with model.grid_parallel=True over a (2, 2, 2)
     grid mesh == the single-device step (same params, same loss)."""

@@ -49,6 +49,7 @@ def _wait_for_steps(proc, metrics_path, n, timeout=240):
     raise AssertionError("timed out waiting for training steps")
 
 
+@pytest.mark.slow
 def test_sigterm_checkpoints_and_resumes(tmp_path):
     ckpt = str(tmp_path / "ckpt")
     metrics = os.path.join(ckpt, "metrics.jsonl")

@@ -6,11 +6,13 @@ here: jax.checkpoint rematerialization vs no remat)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from alphafold2_tpu.models import Alphafold2
 from alphafold2_tpu.models.trunk import Trunk
 
 
+@pytest.mark.slow
 def test_remat_trunk_grad_parity():
     dim, n, m = 16, 6, 2
     key = jax.random.key(0)
@@ -71,6 +73,7 @@ def test_remat_param_isomorphic():
         assert np.allclose(a, b)
 
 
+@pytest.mark.slow
 def test_remat_policy_grad_parity():
     """remat_policy="dots"/"dots_no_batch" (save matmul outputs, skip their
     recompute in backward) must not change gradients — only the

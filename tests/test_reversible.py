@@ -85,6 +85,7 @@ def test_layer_inversion_exact():
         np.testing.assert_allclose(a, b, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_grad_parity_with_dropout():
     """Dropout replay by PRNG key: the custom backward re-runs blocks with
     the same per-layer keys, so gradients still match plain autodiff (the
@@ -110,6 +111,7 @@ def test_grad_parity_with_dropout():
         np.testing.assert_allclose(a, b, atol=2e-4, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_bf16_compute_keeps_f32_carry_and_grad_parity():
     """Under bf16 compute the carried state stays float32 (inversion error
     must not compound in the low-precision carry), and the custom backward
@@ -197,6 +199,7 @@ def test_reversible_rejects_grid_parallel():
         t.init(jax.random.key(0), x, m)
 
 
+@pytest.mark.slow
 def test_reversible_with_sparse_attention():
     """Composition: block-sparse pair attention (its own custom-vjp Pallas
     path) inside the reversible engine's hand-scheduled backward. Values and

@@ -59,6 +59,7 @@ def test_scan_equals_loop_with_stacked_params():
     assert n_loop == n_scan
 
 
+@pytest.mark.slow
 def test_scan_with_remat_grads_match():
     seq, msa, mask, msa_mask = _inputs()
     base = Alphafold2(scan_layers=True, remat=False, **KW)

@@ -1,0 +1,240 @@
+"""Serve-engine tests: bucket-ladder selection, padded-vs-unpadded
+coordinate parity (padding cannot change valid-region output), batching
+parity (co-batched requests cannot change each other), and compile-count
+accounting (mixed lengths in one bucket => exactly 1 compile)."""
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    ServeConfig,
+)
+from alphafold2_tpu.serve import (
+    ServeEngine,
+    ServeRequest,
+    bucket_for,
+    geometric_ladder,
+    padding_fraction,
+    validate_ladder,
+)
+
+
+def _cfg(buckets=(8, 16, 32), max_batch=3, **serve_kw):
+    return Config(
+        model=ModelConfig(dim=32, depth=1, heads=2, dim_head=16,
+                          max_seq_len=3 * max(buckets), bfloat16=False),
+        data=DataConfig(msa_depth=2),
+        serve=ServeConfig(buckets=buckets, max_batch=max_batch,
+                          mds_iters=30, **serve_kw),
+    )
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return ServeEngine(_cfg())
+
+
+# ---------------------------------------------------------------- bucketing
+
+
+def test_bucket_ladder_selection():
+    buckets = (64, 96, 128, 192, 256)
+    assert bucket_for(1, buckets) == 64
+    assert bucket_for(64, buckets) == 64
+    assert bucket_for(65, buckets) == 96
+    assert bucket_for(256, buckets) == 256
+    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+        bucket_for(257, buckets)
+    with pytest.raises(ValueError, match="positive"):
+        bucket_for(0, buckets)
+
+
+def test_ladder_validation_and_geometry():
+    assert validate_ladder([64, 96]) == (64, 96)
+    with pytest.raises(ValueError, match="ascending"):
+        validate_ladder((96, 64))
+    with pytest.raises(ValueError, match="empty"):
+        validate_ladder(())
+    ladder = geometric_ladder(64, 256, ratio=1.5)
+    assert ladder[0] == 64 and ladder[-1] >= 256
+    assert all(a < b for a, b in zip(ladder, ladder[1:]))  # strictly ascends
+    # every request length in range has a rung
+    for n in range(1, 257):
+        assert bucket_for(n, ladder) >= n
+    # padding waste: exact-fit lengths pad nothing
+    assert padding_fraction([64, 96], (64, 96)) == 0.0
+    assert padding_fraction([1], (4,)) == 0.75
+
+
+def test_config_roundtrip_keeps_bucket_tuple():
+    cfg = _cfg(buckets=(8, 16))
+    back = Config.from_json(cfg.to_json())
+    assert back.serve.buckets == (8, 16)
+    over = Config().apply_overrides(["serve.buckets=32,64", "serve.max_batch=2"])
+    assert over.serve.buckets == (32, 64)
+    assert over.serve.max_batch == 2
+
+
+def test_engine_rejects_oversized_ladder():
+    cfg = _cfg(buckets=(8, 64))  # 3*64 > max_seq_len(=96) after _cfg? no:
+    cfg.model.max_seq_len = 96  # force the violation: 3*64=192 > 96
+    with pytest.raises(ValueError, match="max_seq_len"):
+        ServeEngine(cfg)
+
+
+# ------------------------------------------------------- padding/batch parity
+
+
+def test_padded_content_cannot_change_valid_region(engine):
+    """Adversarial pad-content test at a FIXED executable shape: the same
+    request dispatched beside garbage in the padded length region and in the
+    dummy batch slots must produce identical valid-region coordinates."""
+    req = ServeRequest("ACDEFG", seed=3)  # 6 residues in the 8-bucket
+    clean = engine.predict_many([req])[0]
+
+    # hand-build the same dispatch with adversarial padding: garbage tokens
+    # in the padded tail of the valid slot and a garbage (masked) dummy slot
+    import jax
+
+    from alphafold2_tpu import constants
+    from alphafold2_tpu.data.pipeline import featurize_bucketed
+    from alphafold2_tpu.predict import encode_sequence
+
+    bucket, batch = 8, engine.max_batch
+    item = featurize_bucketed(encode_sequence(req.seq)[0], bucket,
+                              engine.msa_depth, seed=req.seed)
+    rng = np.random.default_rng(7)
+    stacked = {
+        "seq": np.stack([item["seq"]] * batch),
+        "mask": np.stack([item["mask"]] * batch),
+        "msa": np.stack([item["msa"]] * batch),
+        "msa_mask": np.stack([item["msa_mask"]] * batch),
+    }
+    # slot 0 carries the request; its masked tail gets garbage tokens
+    stacked["seq"][0, 6:] = rng.integers(0, 20, size=bucket - 6)
+    stacked["msa"][0, :, 6:] = rng.integers(0, 20, size=(engine.msa_depth,
+                                                         bucket - 6))
+    # the other slots are fully-masked garbage (mask all False)
+    for b in range(1, batch):
+        stacked["seq"][b] = rng.integers(0, 20, size=bucket)
+        stacked["msa"][b] = rng.integers(0, 20,
+                                         size=(engine.msa_depth, bucket))
+        stacked["mask"][b] = False
+        stacked["msa_mask"][b] = False
+
+    compiled = engine._get_executable(bucket, batch)
+    out = compiled(engine.params, stacked["seq"], stacked["msa"],
+                   stacked["mask"], stacked["msa_mask"])
+    refined = np.asarray(jax.device_get(out["refined"]))[0, :6]
+    np.testing.assert_allclose(refined, clean.atom14, atol=1e-5)
+
+
+def test_bucket_padding_parity_across_shapes():
+    """The SAME request served from two different bucket shapes must agree
+    on the valid region: masked MDS weights + effective-N Guttman steps +
+    position-keyed init + mask-aware psi make realization shape-blind."""
+    e8 = ServeEngine(_cfg(buckets=(8, 16), max_batch=2))
+    e16 = ServeEngine(_cfg(buckets=(16,), max_batch=2), params=e8.params)
+    r8 = e8.predict_many([ServeRequest("ACDEFGHK", seed=1)])[0]
+    r16 = e16.predict_many([ServeRequest("ACDEFGHK", seed=1)])[0]
+    assert r8.bucket == 8 and r16.bucket == 16
+    np.testing.assert_allclose(r16.atom14, r8.atom14, atol=1e-4)
+    np.testing.assert_allclose(r16.weights, r8.weights, atol=1e-5)
+
+
+def test_batching_parity(engine):
+    """A request's output must not depend on what else rides in the batch
+    or which slot it lands in."""
+    a = ServeRequest("ACDEFG", seed=11)
+    solo = engine.predict_many([a])[0]
+    batched = engine.predict_many(
+        [ServeRequest("MKVLIT", seed=5), a, ServeRequest("AC", seed=9)]
+    )[1]
+    np.testing.assert_allclose(batched.atom14, solo.atom14, atol=1e-5)
+    np.testing.assert_allclose(batched.weights, solo.weights, atol=1e-6)
+
+
+def test_results_align_with_requests(engine):
+    reqs = ["ACDEFGHKLM", "AC", "ACDEFGHKLMNPQRSTVW"]
+    out = engine.predict_many(reqs)
+    for seq, r in zip(reqs, out):
+        assert r.seq == seq
+        assert r.atom14.shape == (len(seq), 14, 3)
+        assert r.backbone.shape == (len(seq), 3, 3)
+        assert r.weights.shape == (3 * len(seq), 3 * len(seq))
+        assert np.all(np.isfinite(r.atom14))
+        assert r.latency_s > 0
+        assert r.distogram is None  # return_distogram defaults off
+
+
+# ------------------------------------------------------- compile accounting
+
+
+def test_mixed_lengths_one_bucket_compile_exactly_once():
+    eng = ServeEngine(_cfg())
+    # 5 requests of 4 distinct lengths, all <= 8 -> one bucket
+    eng.predict_many(["ACDE", "ACDEF", "ACDEFG", "ACDEFGHK", "AC"])
+    s = eng.stats()
+    assert s["serve.compiles"] == 1, s
+    assert s["serve.traces"] == 1, s  # python-side proof: one trace, ever
+    assert s["serve.requests"] == 5
+    assert s["serve.batches"] == 2  # 5 requests / max_batch 3
+    assert s["serve.cache_hits"] == 1  # second dispatch reused the first's
+
+    # a length crossing into the next rung compiles exactly one more
+    eng.predict_many(["ACDEFGHKLMNP"])  # 12 residues -> bucket 16
+    s = eng.stats()
+    assert s["serve.compiles"] == 2, s
+    assert s["serve.traces"] == 2, s
+
+    # and everything after that is cache hits
+    eng.predict_many(["ACD", "ACDEFGHKLM", "ACDEFGHK"])
+    assert eng.stats()["serve.compiles"] == 2
+
+
+def test_warmup_precompiles_ladder():
+    eng = ServeEngine(_cfg(buckets=(8, 16), max_batch=2))
+    snap = eng.warmup()
+    assert snap["serve.compiles"] == 2
+    eng.predict_many(["ACDE", "ACDEFGHKLM"])
+    s = eng.stats()
+    assert s["serve.compiles"] == 2  # traffic compiled nothing new
+    assert s["serve.cache_hits"] == 2
+
+
+# ------------------------------------------------------------------- bench
+
+
+def test_bench_serve_emits_valid_record(monkeypatch):
+    """The acceptance contract: a nonzero residues/sec record, no error
+    field, from real end-to-end timings (tiny config via env knobs)."""
+    monkeypatch.setenv("AF2TPU_SERVE_BUCKETS", "8,16")
+    monkeypatch.setenv("AF2TPU_SERVE_MAX_BATCH", "2")
+    monkeypatch.setenv("AF2TPU_SERVE_REQUESTS", "4")
+    monkeypatch.setenv("AF2TPU_SERVE_DIM", "32")
+    monkeypatch.setenv("AF2TPU_SERVE_DEPTH", "1")
+    monkeypatch.setenv("AF2TPU_SERVE_HEADS", "2")
+    monkeypatch.setenv("AF2TPU_SERVE_DIM_HEAD", "16")
+    monkeypatch.setenv("AF2TPU_SERVE_MSA_DEPTH", "2")
+    monkeypatch.setenv("AF2TPU_SERVE_MDS_ITERS", "8")
+    import bench
+
+    record = bench.bench_serve(emit=False)
+    assert "error" not in record
+    assert record["unit"] == "residues/sec"
+    assert record["value"] > 0
+    assert record["p50_ms"] > 0 and record["p95_ms"] >= record["p50_ms"]
+    assert record["compiles"] == 2  # one per ladder rung (warmup)
+    # env-overridden config: must never claim a baseline comparison
+    assert record["vs_baseline_valid"] is False
+
+
+def test_bench_mode_parsing():
+    import bench
+
+    assert bench.bench_mode([]) == "train"
+    assert bench.bench_mode(["--mode", "serve"]) == "serve"
+    assert bench.bench_mode(["--mode=serve"]) == "serve"

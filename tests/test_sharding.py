@@ -33,6 +33,7 @@ def _cfg(batch_size=4):
     )
 
 
+@pytest.mark.slow
 def test_dp_sp_step_matches_single_device():
     cfg = _cfg(batch_size=4)
     batch = next(iter(SyntheticDataset(cfg.data, seed=0)))
@@ -101,6 +102,7 @@ def test_graft_dryrun_multichip():
     __graft_entry__.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_msa_row_shard_tied_step_matches_single_device():
     """model.msa_row_shard=True: MSA rows sharded P(dp, sp); the tied-row
     logit contraction completes via an XLA-inserted psum over sp (SURVEY §7
@@ -131,6 +133,7 @@ def test_msa_row_shard_tied_step_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_msa_row_shard_composes_with_grid_mesh():
     """msa_row_shard on a (dp, spr, spc) grid mesh: MSA rows shard over spr
     (no sp axis exists), so the tied-row psum composes with 2D pair-grid
