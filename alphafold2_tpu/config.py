@@ -122,6 +122,9 @@ class TrainConfig:
     keep_checkpoints: int = 3
     profile_dir: Optional[str] = None  # jax.profiler trace output
     profile_steps: Tuple[int, int] = (10, 13)
+    # observe.Tracer span output (Chrome trace-event JSONL, Perfetto-
+    # loadable): per-step host-side spans beside the XLA profile above
+    trace_events: Optional[str] = None
 
 
 def _tuplify(section, name):

@@ -1,0 +1,46 @@
+"""Unified observability subsystem: spans, histograms, telemetry, liveness.
+
+The reference's only observability is ``print`` (SURVEY.md S5.1/S5.5), and
+round 5 showed why that is fatal at scale: a whole bench deadline burned
+hung in ``backend_init`` with no structured signal. This package is the
+first-class answer:
+
+- :mod:`tracing` — ``Tracer``/``Span``: nested span tracing emitted as
+  Chrome-trace-event JSONL, loadable in Perfetto / ``chrome://tracing``,
+  wired through the serve request lifecycle, the train step and bench.
+- :mod:`histogram` — streaming log-bucketed ``Histogram`` with
+  p50/p95/p99 snapshots (per-request latency, queue wait, batch occupancy,
+  pad ratio).
+- :mod:`metrics` — ``MetricsLogger`` (structured JSONL + stdout) and
+  thread-safe ``EventCounters`` (compile counts, cache hits, totals).
+- :mod:`memory` — ``MemorySampler`` over ``device.memory_stats()`` (HBM
+  peaks; graceful no-op on backends that expose none).
+- :mod:`watchdog` — ``LivenessWatchdog``: a heartbeat thread with
+  per-stage deadlines backed by a cheap subprocess backend probe, so a
+  dead-at-start backend produces a structured ``liveness: dead`` failure
+  in seconds instead of eating a whole deadline.
+- :mod:`profiler` — ``Profiler``: jax.profiler XLA trace over a step
+  window (TensorBoard/XProf), unchanged from the original train hook.
+
+``alphafold2_tpu.train.observe`` remains as a re-export shim for existing
+imports. ``scripts/obs_report.py`` summarizes the emitted artifacts.
+"""
+
+from alphafold2_tpu.observe.histogram import Histogram
+from alphafold2_tpu.observe.memory import MemorySampler
+from alphafold2_tpu.observe.metrics import EventCounters, MetricsLogger
+from alphafold2_tpu.observe.profiler import Profiler
+from alphafold2_tpu.observe.tracing import Span, Tracer
+from alphafold2_tpu.observe.watchdog import LivenessWatchdog, probe_backend
+
+__all__ = [
+    "EventCounters",
+    "Histogram",
+    "LivenessWatchdog",
+    "MemorySampler",
+    "MetricsLogger",
+    "Profiler",
+    "Span",
+    "Tracer",
+    "probe_backend",
+]

@@ -1,0 +1,84 @@
+"""Device memory telemetry via ``device.memory_stats()``.
+
+TPU/GPU PJRT backends expose per-device allocator stats (``bytes_in_use``,
+``peak_bytes_in_use``, ``bytes_limit``); the CPU backend exposes none (or
+an empty dict depending on jax version). The sampler degrades to a no-op
+there — serving code can call it unconditionally and a CPU-mesh run simply
+records no HBM numbers instead of crashing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+class MemorySampler:
+    """Samples HBM usage across jax devices.
+
+    ``sample()`` returns one record per device that exposes stats (empty
+    list on CPU); ``peak_bytes()`` is the max ``peak_bytes_in_use`` across
+    devices; ``log_to`` emits a summary through a MetricsLogger and
+    ``counter_to`` a Chrome counter event through a Tracer, so traces show
+    HBM alongside the spans that allocated it."""
+
+    def __init__(self, devices=None):
+        self._devices = devices
+
+    def _get_devices(self):
+        if self._devices is not None:
+            return self._devices
+        try:
+            import jax
+
+            return jax.devices()
+        except Exception:
+            return []
+
+    def sample(self) -> list:
+        records = []
+        for d in self._get_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue  # backend exposes no allocator stats (CPU)
+            rec = {"device": str(getattr(d, "id", d))}
+            for k in _KEYS:
+                if k in stats:
+                    rec[k] = int(stats[k])
+            if len(rec) > 1:
+                records.append(rec)
+        return records
+
+    def peak_bytes(self) -> Optional[int]:
+        peaks = [
+            r["peak_bytes_in_use"] for r in self.sample()
+            if "peak_bytes_in_use" in r
+        ]
+        return max(peaks) if peaks else None
+
+    def log_to(self, logger, step: int = 0) -> None:
+        records = self.sample()
+        if not records:
+            return
+        summary = {
+            "hbm_peak_bytes": max(
+                r.get("peak_bytes_in_use", 0) for r in records
+            ),
+            "hbm_in_use_bytes": max(
+                r.get("bytes_in_use", 0) for r in records
+            ),
+            "hbm_devices": len(records),
+        }
+        logger.log(step, summary)
+
+    def counter_to(self, tracer) -> None:
+        for r in self.sample():
+            tracer.counter(
+                f"hbm.device{r['device']}",
+                bytes_in_use=r.get("bytes_in_use", 0),
+                peak_bytes_in_use=r.get("peak_bytes_in_use", 0),
+            )

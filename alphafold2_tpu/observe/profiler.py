@@ -1,0 +1,34 @@
+"""XLA trace capture over a configured train-step window.
+
+Complements the span tracing in :mod:`alphafold2_tpu.observe.tracing`:
+spans time host-side stages; this captures the device-side XLA trace
+(``train.profile_dir`` / ``train.profile_steps``) for TensorBoard/XProf.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+class Profiler:
+    """Start/stop a jax profiler trace across a [start, stop) step window."""
+
+    def __init__(self, trace_dir: Optional[str], steps: Tuple[int, int] = (10, 13)):
+        self._dir = trace_dir
+        self._start, self._stop = steps
+        self._active = False
+
+    def maybe_start(self, step: int) -> None:
+        if self._dir and step == self._start and not self._active:
+            import jax
+
+            jax.profiler.start_trace(self._dir)
+            self._active = True
+
+    def maybe_stop(self, step: int) -> None:
+        if self._active and step >= self._stop:
+            import jax
+
+            jax.block_until_ready(jax.numpy.zeros(()))
+            jax.profiler.stop_trace()
+            self._active = False

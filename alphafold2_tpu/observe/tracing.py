@@ -1,0 +1,198 @@
+"""Span tracing emitted as Chrome-trace-event JSONL.
+
+``Tracer.span("serve.dispatch", bucket=32)`` times a nested region and
+emits one complete ("ph": "X") trace event per span; the output file loads
+directly in Perfetto / ``chrome://tracing`` (the file opens with ``[`` and
+the trace-event spec makes the closing ``]`` optional, so the format is
+simultaneously a streaming JSONL-per-line file and a valid JSON-array
+trace). Nesting is inferred by the viewer from ts/dur overlap within a
+thread — no explicit parent ids needed.
+
+A disabled tracer (no path, ``enabled=False``) is a near-zero-cost no-op,
+so instrumentation can stay permanently wired through hot paths (the serve
+engine, the train step) and be switched on per run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+# one timeline origin per process: spans from every tracer share it, so a
+# serve-engine trace and a bench-stage trace interleave correctly
+_PROC_T0 = time.perf_counter()
+
+
+def _now_us() -> float:
+    return (time.perf_counter() - _PROC_T0) * 1e6
+
+
+class Span:
+    """Handle yielded by ``Tracer.span``: attach args mid-flight via
+    ``set(key=value)`` (e.g. the compile-cache verdict known only at the
+    end of the region)."""
+
+    __slots__ = ("name", "args", "duration_s")
+
+    def __init__(self, name: str, args: dict):
+        self.name = name
+        self.args = args
+        self.duration_s = 0.0
+
+    def set(self, **kw) -> "Span":
+        self.args.update(kw)
+        return self
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def set(self, **kw):
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Thread-safe span tracer writing Chrome trace events.
+
+    ``path=None`` keeps events only in memory (tests, ``span_totals``);
+    ``enabled=False`` disables everything. Events are flushed to the file
+    as they complete, so a killed process still leaves a loadable trace.
+    """
+
+    def __init__(self, path: Optional[str] = None,
+                 enabled: Optional[bool] = None):
+        self.enabled = bool(path) if enabled is None else bool(enabled)
+        self._path = path
+        self._lock = threading.Lock()
+        self._events: list = []
+        self._file = None
+        if self.enabled and path:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            self._file = open(path, "w")
+            self._file.write("[\n")
+            self._file.flush()
+
+    @classmethod
+    def from_env(cls, var: str = "AF2TPU_TRACE_EVENTS") -> "Tracer":
+        """Tracer writing to $AF2TPU_TRACE_EVENTS, disabled when unset."""
+        return cls(path=os.environ.get(var) or None)
+
+    # ------------------------------------------------------------- emission
+
+    def _emit(self, event: dict) -> None:
+        with self._lock:
+            self._events.append(event)
+            if self._file is not None:
+                self._file.write(json.dumps(event) + ",\n")
+                self._file.flush()
+
+    @contextmanager
+    def span(self, name: str, **args):
+        """Time a region; emits one complete event on exit (exceptions
+        included — a span that dies still appears, flagged ``error``)."""
+        if not self.enabled:
+            yield _NULL_SPAN
+            return
+        sp = Span(name, dict(args))
+        t0 = _now_us()
+        try:
+            yield sp
+        except BaseException as e:
+            sp.args["error"] = type(e).__name__
+            raise
+        finally:
+            t1 = _now_us()
+            sp.duration_s = (t1 - t0) / 1e6
+            self._emit({
+                "name": name, "ph": "X", "ts": round(t0, 1),
+                "dur": round(t1 - t0, 1), "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                **({"args": sp.args} if sp.args else {}),
+            })
+
+    def instant(self, name: str, **args) -> None:
+        """A zero-duration marker event (ph "i")."""
+        if not self.enabled:
+            return
+        self._emit({
+            "name": name, "ph": "i", "ts": round(_now_us(), 1), "s": "p",
+            "pid": os.getpid(), "tid": threading.get_ident(),
+            **({"args": dict(args)} if args else {}),
+        })
+
+    def counter(self, name: str, **values) -> None:
+        """A counter sample event (ph "C") — e.g. HBM bytes over time."""
+        if not self.enabled:
+            return
+        self._emit({
+            "name": name, "ph": "C", "ts": round(_now_us(), 1),
+            "pid": os.getpid(), "args": dict(values),
+        })
+
+    # ------------------------------------------------------------ summaries
+
+    def events(self) -> list:
+        with self._lock:
+            return list(self._events)
+
+    def span_totals(self) -> dict:
+        """Per-span-name aggregate: {name: {count, total_s, max_s}} over the
+        complete ("X") events seen so far — the bench records embed this as
+        the per-stage timing breakdown."""
+        out: dict = {}
+        for e in self.events():
+            if e.get("ph") != "X":
+                continue
+            agg = out.setdefault(
+                e["name"], {"count": 0, "total_s": 0.0, "max_s": 0.0}
+            )
+            dur_s = e.get("dur", 0.0) / 1e6
+            agg["count"] += 1
+            agg["total_s"] = round(agg["total_s"] + dur_s, 6)
+            agg["max_s"] = round(max(agg["max_s"], dur_s), 6)
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+                self._file.close()
+                self._file = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def load_trace_events(path: str) -> list:
+    """Parse a trace file written by ``Tracer`` (or any Chrome trace-event
+    JSON array). Tolerates the streaming form: leading ``[``, one event per
+    line with a trailing comma, no closing ``]``."""
+    with open(path) as f:
+        text = f.read().strip()
+    if not text:
+        return []
+    try:  # a well-formed JSON array (or {"traceEvents": [...]})
+        doc = json.loads(text)
+        if isinstance(doc, dict):
+            return doc.get("traceEvents", [])
+        return doc
+    except json.JSONDecodeError:
+        pass
+    events = []
+    for line in text.splitlines():
+        line = line.strip().rstrip(",")
+        if not line or line in ("[", "]"):
+            continue
+        events.append(json.loads(line))
+    return events

@@ -1,0 +1,134 @@
+"""Liveness watchdog: per-stage deadlines backed by a cheap backend probe.
+
+Round 5's bench burned its entire 1500 s deadline hung inside
+``backend_init`` with no structured signal (BENCH_r05.json). The fix
+mirrors ``scripts/tpu_session.py``'s subprocess probe, generalized: a
+heartbeat thread watches which stage the process is in; when a stage
+overstays its deadline, a tiny jax computation runs in a *subprocess* with
+a hard timeout — cheap when the backend answers (seconds), bounded when
+the tunnel is dead. A dead probe fires ``on_dead`` with a structured
+record marked ``liveness: "dead"``; a live probe means slow-but-healthy
+and the stage earns another deadline instead of a spurious kill.
+
+The main process can hang un-interruptibly inside C++ (a dead in-process
+relay), which is exactly why both the checking and the probing live on a
+daemon thread + subprocess: neither needs the hung thread's cooperation.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+_DEFAULT_PROBE_CODE = (
+    "import jax, jax.numpy as jnp; "
+    "assert float(jnp.ones((8, 8)).sum()) == 64.0"
+)
+
+
+def probe_backend(
+    timeout: Optional[float] = None,
+    env: Optional[dict] = None,
+    code: Optional[str] = None,
+) -> Tuple[bool, str]:
+    """One tiny jax computation in a subprocess, hard-bounded. True iff the
+    backend completes it. The child inherits this process's environment
+    (including any relay/site hooks) by default, so it probes the same
+    backend the caller would use. ``AF2TPU_LIVENESS_PROBE_CODE`` overrides
+    the probe body (tests simulate a hung tunnel with a sleep)."""
+    timeout = timeout if timeout is not None else float(
+        os.environ.get("AF2TPU_LIVENESS_TIMEOUT", 25)
+    )
+    code = code or os.environ.get(
+        "AF2TPU_LIVENESS_PROBE_CODE", _DEFAULT_PROBE_CODE
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout, capture_output=True, text=True, env=env,
+        )
+        if r.returncode == 0:
+            return True, "probe ok"
+        return False, f"probe rc={r.returncode}: {r.stderr[-300:]}"
+    except subprocess.TimeoutExpired:
+        return False, f"probe hung >{timeout:.0f}s (dead tunnel)"
+
+
+class LivenessWatchdog:
+    """Heartbeat thread with per-stage deadlines.
+
+    ``stage_fn`` reports the process's current stage name (polled — the
+    hung thread never has to call in); ``deadlines`` maps stage names to
+    seconds (a name matches if it equals the stage or its suffix after the
+    last ``:``, so ``"backend_init"`` covers ``"serve:backend_init"`` and
+    ``"first_light:backend_init"``). Stages with no deadline are
+    unbounded here (an overall-deadline watchdog still covers them).
+
+    On expiry the ``probe`` runs: dead → ``on_dead(record)`` fires once
+    with ``record["liveness"] == "dead"`` and the watchdog stops; alive →
+    the stage's clock resets (it re-probes after another deadline).
+    """
+
+    def __init__(
+        self,
+        stage_fn: Callable[[], str],
+        deadlines: Dict[str, float],
+        on_dead: Callable[[dict], None],
+        probe: Callable[..., Tuple[bool, str]] = probe_backend,
+        poll_s: float = 1.0,
+    ):
+        self._stage_fn = stage_fn
+        self._deadlines = dict(deadlines)
+        self._on_dead = on_dead
+        self._probe = probe
+        self._poll_s = poll_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired: Optional[dict] = None
+
+    def _deadline_for(self, stage: str) -> Optional[float]:
+        if stage in self._deadlines:
+            return self._deadlines[stage]
+        suffix = stage.rsplit(":", 1)[-1]
+        return self._deadlines.get(suffix)
+
+    def start(self) -> "LivenessWatchdog":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        current = self._stage_fn()
+        t0 = time.monotonic()
+        while not self._stop.wait(self._poll_s):
+            stage = self._stage_fn()
+            if stage != current:
+                current, t0 = stage, time.monotonic()
+                continue
+            deadline = self._deadline_for(stage)
+            if deadline is None:
+                continue
+            waited = time.monotonic() - t0
+            if waited <= deadline:
+                continue
+            alive, why = self._probe()
+            if alive:
+                # slow but healthy: earn another deadline, re-probe later
+                t0 = time.monotonic()
+                continue
+            self.fired = {
+                "liveness": "dead",
+                "stage": stage,
+                "waited_s": round(time.monotonic() - t0, 1),
+                "stage_deadline_s": deadline,
+                "probe": why,
+            }
+            self._on_dead(self.fired)
+            return

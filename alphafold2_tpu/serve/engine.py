@@ -17,9 +17,16 @@ layer the ROADMAP north star needs instead:
   bucket shape and batch slot.
 - **Compile accounting** — an in-process executable cache (fronting the
   persistent XLA compilation cache wired in ``alphafold2_tpu/__init__``)
-  counts traces/compiles/cache-hits through a ``train.observe.EventCounters``
+  counts traces/compiles/cache-hits through an ``observe.EventCounters``
   hook, so tests can assert "N mixed-length requests in one bucket ==
   exactly 1 compile" instead of trusting it.
+- **Observability** — every request rides through nested ``observe.Tracer``
+  spans (featurize → get_executable/compile → dispatch → device_get →
+  unpad) emitted as Chrome-trace-event JSONL; per-request queue-wait and
+  dispatch latency, batch occupancy and pad ratio stream into
+  ``observe.Histogram`` distributions (p50/p95/p99 in ``bench.py --mode
+  serve`` records); compile durations are recorded per (bucket, batch)
+  shape in ``compile_records``.
 """
 
 from __future__ import annotations
@@ -35,10 +42,15 @@ import numpy as np
 from alphafold2_tpu import constants
 from alphafold2_tpu.config import Config
 from alphafold2_tpu.data.pipeline import featurize_bucketed
+from alphafold2_tpu.observe import (
+    EventCounters,
+    Histogram,
+    MemorySampler,
+    Tracer,
+)
 from alphafold2_tpu.predict import encode_sequence
 from alphafold2_tpu.serve.bucketing import bucket_for, validate_ladder
 from alphafold2_tpu.train.end2end import End2EndModel
-from alphafold2_tpu.train.observe import EventCounters
 
 
 @dataclasses.dataclass
@@ -59,7 +71,9 @@ class ServeResult:
     backbone: np.ndarray  # (L, 3, 3) N/CA/C
     weights: np.ndarray  # (3L, 3L) distogram confidence (valid region)
     distogram: Optional[np.ndarray]  # (3L, 3L, K) logits when requested
-    latency_s: float  # wall time of the dispatch that served this request
+    latency_s: float  # queue wait + dispatch: what a caller observes
+    queue_wait_s: float = 0.0  # time between arrival and dispatch start
+    dispatch_s: float = 0.0  # device execution + result fetch of the batch
 
 
 def _as_request(r: Union[str, ServeRequest]) -> ServeRequest:
@@ -72,12 +86,19 @@ class ServeEngine:
     >>> engine = ServeEngine(cfg)
     >>> results = engine.predict_many(["ACDEFGH...", "MKV..."])
 
-    ``counters`` (train.observe.EventCounters) accumulates:
+    ``counters`` (observe.EventCounters) accumulates:
     ``serve.requests``, ``serve.batches``, ``serve.traces`` (python trace
     executions), ``serve.compiles`` (XLA executable builds),
     ``serve.cache_hits`` (dispatches served by an already-built
     executable), ``serve.padded_slots`` / ``serve.padded_residues``
     (batch-dim / length-dim padding waste).
+
+    ``tracer`` (observe.Tracer) receives the request-lifecycle spans; the
+    default is a disabled tracer (near-zero overhead). ``histograms``
+    (name -> observe.Histogram) streams ``latency_s`` / ``queue_wait_s`` /
+    ``dispatch_s`` (seconds) and ``batch_occupancy`` / ``pad_ratio``
+    (fractions); ``compile_records`` lists every XLA build as
+    ``{"bucket", "batch", "seconds"}``.
     """
 
     def __init__(
@@ -86,6 +107,7 @@ class ServeEngine:
         params=None,
         checkpoint_dir: Optional[str] = None,
         counters: Optional[EventCounters] = None,
+        tracer: Optional[Tracer] = None,
     ):
         self.cfg = cfg
         self.buckets = validate_ladder(cfg.serve.buckets)
@@ -105,6 +127,16 @@ class ServeEngine:
                 f"{constants.MAX_NUM_MSA}"
             )
         self.counters = counters if counters is not None else EventCounters()
+        self.tracer = tracer if tracer is not None else Tracer(enabled=False)
+        self.memory = MemorySampler()
+        self.histograms = {
+            "latency_s": Histogram(),
+            "queue_wait_s": Histogram(),
+            "dispatch_s": Histogram(),
+            "batch_occupancy": Histogram(),
+            "pad_ratio": Histogram(),
+        }
+        self.compile_records: list = []
         self.model = End2EndModel(
             dim=cfg.model.dim, depth=cfg.model.depth, heads=cfg.model.heads,
             dim_head=cfg.model.dim_head, max_seq_len=cfg.model.max_seq_len,
@@ -187,20 +219,27 @@ class ServeEngine:
         abstract = self._abstract_batch(bucket, batch)
         import warnings
 
-        with warnings.catch_warnings():
-            # feature buffers are int/bool and the outputs are f32 coords,
-            # so XLA cannot ALIAS the donation (and says so per compile);
-            # donating still lets the runtime release the request buffers
-            # during execution, which is the point on HBM-tight serving
-            warnings.filterwarnings(
-                "ignore", message="Some donated buffers were not usable"
-            )
-            compiled = (
-                jax.jit(self._fwd, donate_argnums=donate)
-                .lower(self.params, *abstract)
-                .compile()
-            )
+        t0 = time.perf_counter()
+        with self.tracer.span("serve.compile", bucket=bucket, batch=batch):
+            with warnings.catch_warnings():
+                # feature buffers are int/bool and the outputs are f32
+                # coords, so XLA cannot ALIAS the donation (and says so per
+                # compile); donating still lets the runtime release the
+                # request buffers during execution, which is the point on
+                # HBM-tight serving
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable"
+                )
+                compiled = (
+                    jax.jit(self._fwd, donate_argnums=donate)
+                    .lower(self.params, *abstract)
+                    .compile()
+                )
         self.counters.bump("serve.compiles")
+        self.compile_records.append({
+            "bucket": bucket, "batch": batch,
+            "seconds": round(time.perf_counter() - t0, 4),
+        })
         self._executables[key] = compiled
         return compiled
 
@@ -233,69 +272,108 @@ class ServeEngine:
             by_bucket.setdefault(b, []).append(i)
 
         results: list = [None] * len(reqs)
+        arrival = time.perf_counter()  # queue-wait origin for this stream
         for bucket in sorted(by_bucket):
             order = by_bucket[bucket]
             for lo in range(0, len(order), self.max_batch):
                 chunk = order[lo : lo + self.max_batch]
-                self._dispatch(bucket, [reqs[i] for i in chunk], chunk, results)
+                self._dispatch(
+                    bucket, [reqs[i] for i in chunk], chunk, results, arrival
+                )
         return results
 
-    def _dispatch(self, bucket, chunk_reqs, chunk_idx, results):
+    def _dispatch(self, bucket, chunk_reqs, chunk_idx, results, arrival=None):
         n_real = len(chunk_reqs)
         batch = self.max_batch if self.cfg.serve.pad_batches else n_real
         self.counters.bump("serve.batches")
         self.counters.bump("serve.padded_slots", batch - n_real)
+        t_start = time.perf_counter()
+        queue_wait = t_start - arrival if arrival is not None else 0.0
+        self.histograms["queue_wait_s"].observe(queue_wait)
+        self.histograms["batch_occupancy"].observe(n_real / batch)
 
-        items = []
-        for r in chunk_reqs:
-            tokens = encode_sequence(r.seq)[0]
-            items.append(
-                featurize_bucketed(
-                    tokens, bucket, self.msa_depth, seed=r.seed
+        with self.tracer.span(
+            "serve.batch", bucket=bucket, batch=batch, n_real=n_real
+        ) as batch_span:
+            with self.tracer.span("serve.featurize", bucket=bucket):
+                items = []
+                for r in chunk_reqs:
+                    tokens = encode_sequence(r.seq)[0]
+                    items.append(
+                        featurize_bucketed(
+                            tokens, bucket, self.msa_depth, seed=r.seed
+                        )
+                    )
+                    pad = bucket - len(r.seq)
+                    self.counters.bump("serve.padded_residues", pad)
+                    self.histograms["pad_ratio"].observe(pad / bucket)
+                for _ in range(batch - n_real):  # fully-masked dummy slots
+                    items.append({
+                        "seq": np.full(
+                            bucket, constants.AA_PAD_INDEX, np.int32
+                        ),
+                        "mask": np.zeros(bucket, bool),
+                        "msa": np.full(
+                            (self.msa_depth, bucket), constants.AA_PAD_INDEX,
+                            np.int32,
+                        ),
+                        "msa_mask": np.zeros((self.msa_depth, bucket), bool),
+                    })
+                stacked = {
+                    k: np.stack([it[k] for it in items]) for k in items[0]
+                }
+
+            with self.tracer.span(
+                "serve.get_executable", bucket=bucket, batch=batch
+            ) as exe_span:
+                before = self.counters.get("serve.compiles")
+                compiled = self._get_executable(bucket, batch)
+                exe_span.set(
+                    compiled_now=self.counters.get("serve.compiles") > before
                 )
-            )
-            self.counters.bump("serve.padded_residues", bucket - len(r.seq))
-        for _ in range(batch - n_real):  # fully-masked dummy slots
-            items.append({
-                "seq": np.full(bucket, constants.AA_PAD_INDEX, np.int32),
-                "mask": np.zeros(bucket, bool),
-                "msa": np.full(
-                    (self.msa_depth, bucket), constants.AA_PAD_INDEX, np.int32
-                ),
-                "msa_mask": np.zeros((self.msa_depth, bucket), bool),
-            })
-        stacked = {k: np.stack([it[k] for it in items]) for k in items[0]}
 
-        compiled = self._get_executable(bucket, batch)
-        t0 = time.perf_counter()
-        out = compiled(
-            self.params, stacked["seq"], stacked["msa"], stacked["mask"],
-            stacked["msa_mask"],
-        )
-        # fetch the values, not just readiness: the timed region must close
-        # on device completion (the bench's validity contract)
-        refined = np.asarray(jax.device_get(out["refined"]))
-        weights = np.asarray(jax.device_get(out["weights"]))
-        disto = (
-            np.asarray(jax.device_get(out["distogram"]))
-            if "distogram" in out else None
-        )
-        latency = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            with self.tracer.span("serve.dispatch", bucket=bucket):
+                out = compiled(
+                    self.params, stacked["seq"], stacked["msa"],
+                    stacked["mask"], stacked["msa_mask"],
+                )
+            # fetch the values, not just readiness: the timed region must
+            # close on device completion (the bench's validity contract)
+            with self.tracer.span("serve.device_get", bucket=bucket):
+                refined = np.asarray(jax.device_get(out["refined"]))
+                weights = np.asarray(jax.device_get(out["weights"]))
+                disto = (
+                    np.asarray(jax.device_get(out["distogram"]))
+                    if "distogram" in out else None
+                )
+            dispatch_s = time.perf_counter() - t0
+            batch_span.set(dispatch_s=round(dispatch_s, 4))
+            self.histograms["dispatch_s"].observe(dispatch_s)
+            self.memory.counter_to(self.tracer)  # HBM beside the spans
 
-        for slot, (req, idx) in enumerate(zip(chunk_reqs, chunk_idx)):
-            L = len(req.seq)
-            atom14 = refined[slot, :L]
-            results[idx] = ServeResult(
-                seq=req.seq,
-                bucket=bucket,
-                atom14=atom14,
-                backbone=atom14[:, :3],
-                weights=weights[slot, : 3 * L, : 3 * L],
-                distogram=(
-                    disto[slot, : 3 * L, : 3 * L] if disto is not None else None
-                ),
-                latency_s=latency,
-            )
+            with self.tracer.span("serve.unpad", bucket=bucket):
+                latency = queue_wait + dispatch_s
+                for slot, (req, idx) in enumerate(
+                    zip(chunk_reqs, chunk_idx)
+                ):
+                    L = len(req.seq)
+                    atom14 = refined[slot, :L]
+                    self.histograms["latency_s"].observe(latency)
+                    results[idx] = ServeResult(
+                        seq=req.seq,
+                        bucket=bucket,
+                        atom14=atom14,
+                        backbone=atom14[:, :3],
+                        weights=weights[slot, : 3 * L, : 3 * L],
+                        distogram=(
+                            disto[slot, : 3 * L, : 3 * L]
+                            if disto is not None else None
+                        ),
+                        latency_s=latency,
+                        queue_wait_s=queue_wait,
+                        dispatch_s=dispatch_s,
+                    )
 
     def warmup(self) -> dict:
         """Compile every ladder rung ahead of traffic (one dummy dispatch
@@ -308,3 +386,14 @@ class ServeEngine:
 
     def stats(self) -> dict:
         return self.counters.snapshot()
+
+    def histogram_snapshots(self, unit_scale: float = 1.0) -> dict:
+        """One summary dict per latency/occupancy distribution; the time
+        histograms (``*_s``) are scaled by ``unit_scale`` (1e3 → ms)."""
+        return {
+            name: h.snapshot(
+                unit_scale=unit_scale if name.endswith("_s") else 1.0,
+                digits=4,
+            )
+            for name, h in self.histograms.items()
+        }

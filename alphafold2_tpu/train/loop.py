@@ -344,8 +344,8 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
     import time
 
     from alphafold2_tpu.data.pipeline import make_dataset
+    from alphafold2_tpu.observe import MetricsLogger, Profiler, Tracer
     from alphafold2_tpu.train.checkpoint import CheckpointManager
-    from alphafold2_tpu.train.observe import MetricsLogger, Profiler
 
     num_steps = num_steps or cfg.train.num_steps
     owns_dataset = dataset is None
@@ -401,6 +401,10 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
 
     logger = MetricsLogger(cfg.train.checkpoint_dir)
     profiler = Profiler(cfg.train.profile_dir, cfg.train.profile_steps)
+    # host-side span trace beside the XLA profile: step dispatch, batch
+    # fetch and checkpoint writes as Chrome trace events (observe.Tracer);
+    # disabled (near-zero overhead) unless train.trace_events is set
+    tracer = Tracer(cfg.train.trace_events)
     rng = jax.random.key(cfg.train.seed + 1)
 
     # preemption safety (SURVEY.md S5.3 — the reference has no failure
@@ -445,7 +449,8 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
     for i in range(start_step, num_steps):
         profiler.maybe_start(i)
         rng, step_rng = jax.random.split(rng)
-        state, metrics = step_fn(state, batch, step_rng)
+        with tracer.span("train.step", step=i):
+            state, metrics = step_fn(state, batch, step_rng)
         profiler.maybe_stop(i)
         if (i + 1) % cfg.train.log_every == 0 or i == 0:
             m = {k: float(v) for k, v in metrics.items()}
@@ -457,14 +462,16 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
         for cb in callbacks:
             cb(i, state, metrics)
         if ckpt is not None and (i + 1) % cfg.train.checkpoint_every == 0:
-            ckpt.save(i + 1, state)
+            with tracer.span("train.checkpoint", step=i + 1):
+                ckpt.save(i + 1, state)
         if ckpt is not None and stop_agreed():
             stop["requested"] = True
             logger.log(i, {"preempted": 1.0})
             if ckpt.latest_step() != i + 1:
                 ckpt.save(i + 1, state)
             break
-        batch = next(prefetched)
+        with tracer.span("train.next_batch", step=i + 1):
+            batch = next(prefetched)
     if prev_handler is not None:
         signal.signal(signal.SIGTERM, prev_handler)
     if ckpt is not None:
@@ -473,4 +480,5 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
         ckpt.wait()
     if owns_dataset and hasattr(dataset, "close"):
         dataset.close()  # shut down native prefetch workers
+    tracer.close()
     return state

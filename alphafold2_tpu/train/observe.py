@@ -1,92 +1,25 @@
-"""Observability: metric logging + jax.profiler trace hooks.
-
-The reference's only observability is ``print`` (train_pre.py:92,
-SURVEY.md S5.1/S5.5). Here: structured JSONL metrics (greppable, plottable)
-plus stdout, and a profiler that captures an XLA trace for a configured step
-window (``train.profile_dir`` / ``train.profile_steps``) viewable in
-TensorBoard/XProf — the first-class tracing subsystem SURVEY.md asks for.
+"""Re-export shim: the observability subsystem moved to
+:mod:`alphafold2_tpu.observe` (spans, histograms, memory telemetry and the
+liveness watchdog live there alongside these originals). Existing imports
+of ``alphafold2_tpu.train.observe`` keep working unchanged.
 """
 
-from __future__ import annotations
+from alphafold2_tpu.observe import (  # noqa: F401
+    EventCounters,
+    Histogram,
+    MemorySampler,
+    MetricsLogger,
+    Profiler,
+    Span,
+    Tracer,
+)
 
-import json
-import os
-import time
-from typing import Optional, Tuple
-
-
-class MetricsLogger:
-    """JSONL + stdout metrics. In multi-host runs only process 0 logs —
-    otherwise every host appends to the same metrics.jsonl on shared
-    storage (duplicated and potentially interleaved records)."""
-
-    def __init__(self, directory: Optional[str] = None, filename: str = "metrics.jsonl"):
-        import jax
-
-        self._enabled = jax.process_index() == 0
-        self._path = None
-        if directory and self._enabled:
-            os.makedirs(directory, exist_ok=True)
-            self._path = os.path.join(directory, filename)
-
-    def log(self, step: int, metrics: dict) -> None:
-        if not self._enabled:
-            return
-        record = {"step": step, "time": time.time(), **metrics}
-        line = json.dumps(record)
-        print(f"[step {step}] " + " ".join(
-            f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
-            for k, v in metrics.items()
-        ), flush=True)
-        if self._path:
-            with open(self._path, "a") as f:
-                f.write(line + "\n")
-
-
-class EventCounters:
-    """Named monotonic counters for process-local accounting (compile
-    counts, cache hits, request totals). Same spirit as MetricsLogger but
-    for events without a step axis: ``bump`` from anywhere, ``snapshot``
-    into a record, ``log_to`` to emit through a MetricsLogger. The serve
-    engine's compile-count/cache-hit instrumentation is built on this so
-    tests can assert exact executable-cache behavior."""
-
-    def __init__(self):
-        self._counts: dict = {}
-
-    def bump(self, name: str, n: int = 1) -> int:
-        self._counts[name] = self._counts.get(name, 0) + n
-        return self._counts[name]
-
-    def get(self, name: str) -> int:
-        return self._counts.get(name, 0)
-
-    def snapshot(self) -> dict:
-        return dict(self._counts)
-
-    def log_to(self, logger: "MetricsLogger", step: int = 0) -> None:
-        logger.log(step, self.snapshot())
-
-
-class Profiler:
-    """Start/stop a jax profiler trace across a [start, stop) step window."""
-
-    def __init__(self, trace_dir: Optional[str], steps: Tuple[int, int] = (10, 13)):
-        self._dir = trace_dir
-        self._start, self._stop = steps
-        self._active = False
-
-    def maybe_start(self, step: int) -> None:
-        if self._dir and step == self._start and not self._active:
-            import jax
-
-            jax.profiler.start_trace(self._dir)
-            self._active = True
-
-    def maybe_stop(self, step: int) -> None:
-        if self._active and step >= self._stop:
-            import jax
-
-            jax.block_until_ready(jax.numpy.zeros(()))
-            jax.profiler.stop_trace()
-            self._active = False
+__all__ = [
+    "EventCounters",
+    "Histogram",
+    "MemorySampler",
+    "MetricsLogger",
+    "Profiler",
+    "Span",
+    "Tracer",
+]

@@ -118,7 +118,10 @@ def fast_relax(
     jittable, batched, differentiable (gradients flow to ``backbone``)."""
     backbone = jnp.asarray(backbone, jnp.float32)
     ref = jax.lax.stop_gradient(backbone)
-    opt = optax.adam(lr)
+    # eps_root: differentiating THROUGH the relaxation backprops across
+    # adam's sqrt(v); at v=0 (any zero first-step gradient component) that
+    # derivative is NaN without a regularizer inside the root
+    opt = optax.adam(lr, eps_root=1e-8)
 
     def e_total(c):
         return backbone_energy(c, ref, mask=mask, **energy_kw)

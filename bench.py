@@ -52,6 +52,14 @@ INGRAPH = _env_int("AF2TPU_BENCH_INGRAPH", 8)  # scan trip count: compile
 # <= 0 disables the watchdog. Default leaves margin under the observed
 # >=30 min driver budget while tolerating a slow (~5 min) tunnel compile.
 DEADLINE = _env_int("AF2TPU_BENCH_DEADLINE", 1500)
+# per-stage liveness deadline (observe.LivenessWatchdog): a dead-at-start
+# backend must yield a structured `liveness: dead` failure record in
+# seconds, not eat the whole DEADLINE hung in backend_init (BENCH_r05 lost
+# its entire 1500s exactly so). When a backend_init phase overstays this,
+# a subprocess probe (AF2TPU_LIVENESS_TIMEOUT, default 25s) decides dead
+# (fail fast, record marked liveness: dead — total < 60s with defaults)
+# vs slow-but-alive (the stage earns another deadline). <= 0 disables.
+INIT_DEADLINE = _env_int("AF2TPU_BENCH_INIT_DEADLINE", 30)
 
 
 # ATTEMPTS/DEADLINE/COLD_EXTRA/DRIVER_BUDGET tune retry/timeout infra, not
@@ -62,6 +70,8 @@ _INFRA_KNOBS = {
     "AF2TPU_BENCH_EPOCH0",  # wall-clock anchor set by __main__ itself
     "AF2TPU_BENCH_FIRST_LIGHT",  # fallback policy, not a config size
     "AF2TPU_BENCH_MODE",  # train vs serve routing, not a config size
+    "AF2TPU_BENCH_INIT_DEADLINE",  # liveness watchdog, not a config size
+    "AF2TPU_BENCH_SIMULATE_HANG",  # liveness-test hook, not a config size
 }
 
 
@@ -100,6 +110,55 @@ _FIRST_LIGHT = {"record": None}
 
 # one clock validation per process (first_light + flagship share it)
 _CLOCK = {"probe": None}
+
+
+from contextlib import contextmanager
+
+from alphafold2_tpu.observe import (
+    LivenessWatchdog,
+    MemorySampler,
+    MetricsLogger,
+    Tracer,
+)
+
+
+def _tracer() -> Tracer:
+    """Span tracer for this bench invocation: Chrome trace-event JSONL at
+    $AF2TPU_TRACE_EVENTS (Perfetto-loadable), disabled when unset."""
+    return Tracer.from_env()
+
+
+def _metrics_logger():
+    """Structured JSONL metrics at $AF2TPU_METRICS_DIR/metrics.jsonl
+    (compile records, counters, HBM peaks — obs_report.py reads it);
+    None when unset. enabled=True: the bench is single-process, and the
+    logger must not touch jax.process_index() before backend init."""
+    directory = os.environ.get("AF2TPU_METRICS_DIR")
+    if not directory:
+        return None
+    return MetricsLogger(directory, enabled=True, echo=False)
+
+
+@contextmanager
+def _bench_stage(tracer: Tracer, name: str, **args):
+    """One bench stage: sets the watchdog-visible phase and opens a span."""
+    _PHASE["name"] = name
+    _maybe_simulate_hang(name)
+    with tracer.span(f"bench.{name}", **args) as sp:
+        yield sp
+
+
+def _maybe_simulate_hang(stage: str) -> None:
+    """Test hook: AF2TPU_BENCH_SIMULATE_HANG="<substring>:<seconds>" sleeps
+    inside the first stage whose name contains the substring — a stand-in
+    for a backend hung in C++ (the liveness watchdog tests drive bench.py
+    end to end with it). Inert when unset."""
+    spec = os.environ.get("AF2TPU_BENCH_SIMULATE_HANG")
+    if not spec:
+        return
+    name, _, secs = spec.partition(":")
+    if name and name in stage:
+        time.sleep(float(secs or 3600))
 
 
 def _clock_probe(m: int | None = None, size: int = 4096, iters: int = 4):
@@ -165,8 +224,11 @@ def _clock_probe(m: int | None = None, size: int = 4096, iters: int = 4):
     }
 
 
-def main(overrides: dict | None = None, emit: bool = True):
+def main(overrides: dict | None = None, emit: bool = True,
+         tracer: Tracer | None = None):
     o = overrides or {}
+    owns_tracer = tracer is None
+    tracer = tracer if tracer is not None else _tracer()
     crop = o.get("crop", CROP)
     msa_depth = o.get("msa_depth", MSA_DEPTH)
     msa_len = o.get("msa_len", MSA_LEN)
@@ -196,15 +258,15 @@ def main(overrides: dict | None = None, emit: bool = True):
         train=TrainConfig(gradient_accumulate_every=1, warmup_steps=10),
     )
 
-    _PHASE["name"] = phase_prefix + "backend_init"
-    data_batch = next(iter(SyntheticDataset(cfg.data, seed=0)))
-    model = build_model(cfg)
-    # init at tiny slices of the batch: identical params, none of the
-    # full-size init compile (train.loop.tiny_init_state)
-    state = tiny_init_state(cfg, model, data_batch)
-    raw_step = make_train_step(model, mesh=None, jit=False)
-    dev_batch = device_put_batch(data_batch)
-    rng = jax.random.key(0)
+    with _bench_stage(tracer, phase_prefix + "backend_init"):
+        data_batch = next(iter(SyntheticDataset(cfg.data, seed=0)))
+        model = build_model(cfg)
+        # init at tiny slices of the batch: identical params, none of the
+        # full-size init compile (train.loop.tiny_init_state)
+        state = tiny_init_state(cfg, model, data_batch)
+        raw_step = make_train_step(model, mesh=None, jit=False)
+        dev_batch = device_put_batch(data_batch)
+        rng = jax.random.key(0)
 
     # chain INGRAPH steps inside one program: per-dispatch host/tunnel
     # latency is amortized and the timed region is device-bound
@@ -220,25 +282,25 @@ def main(overrides: dict | None = None, emit: bool = True):
 
     # AOT-compile once: the same executable serves warmup, the timed loop,
     # and the FLOPs count for MFU (no second trace/compile)
-    _PHASE["name"] = phase_prefix + "trace_compile"
-    compiled = jax.jit(multi_step, donate_argnums=0).lower(
-        state, dev_batch, rng
-    ).compile()
+    with _bench_stage(tracer, phase_prefix + "trace_compile"):
+        compiled = jax.jit(multi_step, donate_argnums=0).lower(
+            state, dev_batch, rng
+        ).compile()
 
-    _PHASE["name"] = phase_prefix + "warmup_run"
-    for i in range(WARMUP):
-        rng, r = jax.random.split(rng)
-        state, loss = compiled(state, dev_batch, r)
-    if WARMUP:
-        # Sync by fetching the VALUE, not just readiness: over the tunneled
-        # backend, block_until_ready has returned before device completion
-        # (round-1's withdrawn 44.9M pairs/s and round-4's 1084%-of-peak
-        # first record — both physically impossible). A device_get of the
-        # chained loss cannot resolve early: the bytes don't exist until
-        # the whole scan has run.
-        jax.device_get(loss)
-    else:
-        jax.block_until_ready(state.params)
+    with _bench_stage(tracer, phase_prefix + "warmup_run"):
+        for i in range(WARMUP):
+            rng, r = jax.random.split(rng)
+            state, loss = compiled(state, dev_batch, r)
+        if WARMUP:
+            # Sync by fetching the VALUE, not just readiness: over the
+            # tunneled backend, block_until_ready has returned before
+            # device completion (round-1's withdrawn 44.9M pairs/s and
+            # round-4's 1084%-of-peak first record — both physically
+            # impossible). A device_get of the chained loss cannot resolve
+            # early: the bytes don't exist until the whole scan has run.
+            jax.device_get(loss)
+        else:
+            jax.block_until_ready(state.params)
 
     # validate the clock itself before trusting the timed region with it
     # (once per process; the flagship run reuses first_light's verdict)
@@ -247,19 +309,19 @@ def main(overrides: dict | None = None, emit: bool = True):
         and jax.devices()[0].platform != "cpu"
         and _CLOCK["probe"] is None
     ):
-        _PHASE["name"] = phase_prefix + "clock_probe"
-        _CLOCK["probe"] = _clock_probe()
+        with _bench_stage(tracer, phase_prefix + "clock_probe"):
+            _CLOCK["probe"] = _clock_probe()
 
-    _PHASE["name"] = phase_prefix + "timed_run"
-    t0 = time.perf_counter()
-    for i in range(ITERS):
-        rng, r = jax.random.split(rng)
-        state, loss = compiled(state, dev_batch, r)
-    # one scalar fetch closes the timed region (see warmup comment); its
-    # single tunnel round-trip amortizes over ITERS*INGRAPH steps and can
-    # only make the measurement conservative, never inflate it
-    jax.device_get(loss)
-    dt = (time.perf_counter() - t0) / (ITERS * INGRAPH)
+    with _bench_stage(tracer, phase_prefix + "timed_run"):
+        t0 = time.perf_counter()
+        for i in range(ITERS):
+            rng, r = jax.random.split(rng)
+            state, loss = compiled(state, dev_batch, r)
+        # one scalar fetch closes the timed region (see warmup comment);
+        # its single tunnel round-trip amortizes over ITERS*INGRAPH steps
+        # and can only make the measurement conservative, never inflate it
+        jax.device_get(loss)
+        dt = (time.perf_counter() - t0) / (ITERS * INGRAPH)
     _PHASE["name"] = phase_prefix + "record"
 
     pairs_per_sec = batch * crop * crop / dt
@@ -354,6 +416,21 @@ def main(overrides: dict | None = None, emit: bool = True):
             **({"mfu": fl["mfu"]} if "mfu" in fl else {}),
             **({"implausible": True} if fl.get("implausible") else {}),
         }
+    spans = tracer.span_totals()
+    if spans:
+        record["spans"] = spans
+    hbm_peak = MemorySampler().peak_bytes()
+    if hbm_peak is not None:
+        record["hbm_peak_bytes"] = hbm_peak
+    logger = _metrics_logger()
+    if logger is not None:
+        logger.log(0, {
+            k: v for k, v in record.items()
+            if isinstance(v, (int, float, str, bool))
+        })
+        MemorySampler().log_to(logger)
+    if owns_tracer:
+        tracer.close()
     if emit:
         _emit(record)
     return record
@@ -408,16 +485,19 @@ def _serve_metric(s: dict) -> str:
     )
 
 
-def bench_serve(emit: bool = True) -> dict:
+def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
     """Serving throughput/latency on the bucketed batched engine.
 
     Measures a mixed-length request stream end to end: residues/sec over
-    the whole stream plus p50/p95 per-request latency (the wall time of the
-    dispatch that carried the request — what a caller observes). Compiles
-    happen in an explicit warmup and are reported separately; the timed
-    region closes on jax.device_get of the output coordinates, so the
-    numbers are real completions, not dispatch acks (clock-probe-checked on
-    non-CPU backends like the main bench)."""
+    the whole stream plus p50/p95/p99 per-request latency from the
+    engine's streaming Histogram (queue wait + dispatch — what a caller
+    observes), with queue-wait/dispatch/batch-occupancy/pad-ratio
+    distributions and per-stage span timings alongside. Compiles happen
+    in an explicit warmup and are reported separately (per-(bucket,batch)
+    durations in ``compile_records``); the timed region closes on
+    jax.device_get of the output coordinates, so the numbers are real
+    completions, not dispatch acks (clock-probe-checked on non-CPU
+    backends like the main bench)."""
     import numpy as np
 
     from alphafold2_tpu.config import (
@@ -425,21 +505,23 @@ def bench_serve(emit: bool = True) -> dict:
     )
     from alphafold2_tpu.serve import ServeEngine, ServeRequest, padding_fraction
 
+    owns_tracer = tracer is None
+    tracer = tracer if tracer is not None else _tracer()
     s = _serve_sizes()
-    _PHASE["name"] = "serve:backend_init"
-    cfg = Config(
-        model=ModelConfig(
-            dim=s["dim"], depth=s["depth"], heads=s["heads"],
-            dim_head=s["dim_head"], max_seq_len=3 * s["buckets"][-1],
-            bfloat16=jax.devices()[0].platform != "cpu",
-        ),
-        data=DataConfig(msa_depth=s["msa_depth"]),
-        serve=ServeConfig(
-            buckets=s["buckets"], max_batch=s["max_batch"],
-            mds_iters=s["mds_iters"],
-        ),
-    )
-    engine = ServeEngine(cfg)
+    with _bench_stage(tracer, "serve:backend_init"):
+        cfg = Config(
+            model=ModelConfig(
+                dim=s["dim"], depth=s["depth"], heads=s["heads"],
+                dim_head=s["dim_head"], max_seq_len=3 * s["buckets"][-1],
+                bfloat16=jax.devices()[0].platform != "cpu",
+            ),
+            data=DataConfig(msa_depth=s["msa_depth"]),
+            serve=ServeConfig(
+                buckets=s["buckets"], max_batch=s["max_batch"],
+                mds_iters=s["mds_iters"],
+            ),
+        )
+        engine = ServeEngine(cfg, tracer=tracer)
 
     # deterministic mixed-length request stream spanning the ladder
     rng = np.random.default_rng(s["seed"])
@@ -453,38 +535,44 @@ def bench_serve(emit: bool = True) -> dict:
         for i, n in enumerate(lengths)
     ]
 
-    _PHASE["name"] = "serve:trace_compile"
-    t0 = time.perf_counter()
-    engine.warmup()  # one executable per ladder rung, counted
-    compile_s = time.perf_counter() - t0
+    with _bench_stage(tracer, "serve:trace_compile"):
+        t0 = time.perf_counter()
+        engine.warmup()  # one executable per ladder rung, counted
+        compile_s = time.perf_counter() - t0
 
     if (
         os.environ.get("AF2TPU_BENCH_CLOCK_CHECK", "1") != "0"
         and jax.devices()[0].platform != "cpu"
         and _CLOCK["probe"] is None
     ):
-        _PHASE["name"] = "serve:clock_probe"
-        _CLOCK["probe"] = _clock_probe()
+        with _bench_stage(tracer, "serve:clock_probe"):
+            _CLOCK["probe"] = _clock_probe()
 
-    _PHASE["name"] = "serve:timed_run"
-    t0 = time.perf_counter()
-    results = engine.predict_many(reqs)
-    wall = time.perf_counter() - t0
+    with _bench_stage(tracer, "serve:timed_run"):
+        t0 = time.perf_counter()
+        results = engine.predict_many(reqs)
+        wall = time.perf_counter() - t0
     _PHASE["name"] = "serve:record"
 
     total_residues = int(sum(len(r.seq) for r in reqs))
-    lat_ms = sorted(1e3 * r.latency_s for r in results)
-    p50 = lat_ms[len(lat_ms) // 2]
-    p95 = lat_ms[min(len(lat_ms) - 1, int(0.95 * len(lat_ms)))]
+    assert all(r is not None for r in results)
     stats = engine.stats()
+    hists = {  # time histograms scaled seconds -> ms, renamed to match
+        (n[:-2] + "_ms" if n.endswith("_s") else n): snap
+        for n, snap in engine.histogram_snapshots(unit_scale=1e3).items()
+    }
+    lat = hists["latency_ms"]
 
     record = {
         "metric": _serve_metric(s),
         "value": round(total_residues / wall, 1),
         "unit": "residues/sec",
         "mode": "serve",
-        "p50_ms": round(p50, 1),
-        "p95_ms": round(p95, 1),
+        # per-request latency percentiles from the streaming Histogram
+        # (queue wait + dispatch, ms)
+        "p50_ms": round(lat["p50"], 1),
+        "p95_ms": round(lat["p95"], 1),
+        "p99_ms": round(lat["p99"], 1),
         "compile_s": round(compile_s, 1),
         "compiles": stats.get("serve.compiles", 0),
         "cache_hits": stats.get("serve.cache_hits", 0),
@@ -493,8 +581,18 @@ def bench_serve(emit: bool = True) -> dict:
         "padding_fraction": round(
             padding_fraction([len(r.seq) for r in reqs], s["buckets"]), 3
         ),
+        # queue-wait/dispatch breakdown + occupancy/pad distributions
+        "histograms": hists,
+        # XLA build durations keyed by executable shape
+        "compile_records": engine.compile_records,
         "device": jax.devices()[0].device_kind,
     }
+    spans = tracer.span_totals()
+    if spans:
+        record["spans"] = spans
+    hbm_peak = engine.memory.peak_bytes()
+    if hbm_peak is not None:
+        record["hbm_peak_bytes"] = hbm_peak
     if _CLOCK["probe"] is not None:
         record["clock_probe"] = _CLOCK["probe"]
         if not _CLOCK["probe"]["ok"]:
@@ -535,6 +633,16 @@ def bench_serve(emit: bool = True) -> dict:
             json.dump(record, f, indent=2)
         print(f"recorded serve baseline -> {baseline_path}", file=sys.stderr)
 
+    logger = _metrics_logger()
+    if logger is not None:
+        logger.log(0, stats)
+        logger.log(0, {
+            k: v for k, v in record.items()
+            if isinstance(v, (int, float, str, bool))
+        })
+        MemorySampler().log_to(logger)
+    if owns_tracer:
+        tracer.close()
     if emit:
         _emit(record)
     return record
@@ -761,6 +869,28 @@ if __name__ == "__main__":
     # be able to outlive a short driver-set deadline with nothing on stdout
     if DEADLINE > 0:
         threading.Thread(target=_watchdog, daemon=True).start()
+
+    # liveness watchdog (observe.LivenessWatchdog): a backend_init phase
+    # overstaying INIT_DEADLINE triggers the cheap subprocess probe — dead
+    # backend => structured `liveness: dead` failure record in well under a
+    # minute (30s stage + 25s probe by default) instead of BENCH_r05's
+    # silent 1500s burn; slow-but-alive => the stage earns another deadline
+    def _on_liveness_dead(info: dict) -> None:
+        rec = _failure_record(
+            f"backend liveness dead: phase '{info['stage']}' exceeded its "
+            f"{info['stage_deadline_s']}s stage deadline and the backend "
+            f"probe failed ({info['probe']})"
+        )
+        rec.update(info)
+        _emit(rec)
+        os._exit(0)
+
+    if INIT_DEADLINE > 0:
+        LivenessWatchdog(
+            stage_fn=lambda: _PHASE["name"],
+            deadlines={"backend_init": INIT_DEADLINE},
+            on_dead=_on_liveness_dead,
+        ).start()
 
     if bench_mode() == "serve":
         # the serve bench runs wherever the engine runs (the CPU mesh

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Summarize observability artifacts: trace-event JSONL + metrics.jsonl.
+
+    python scripts/obs_report.py trace.json [metrics.jsonl ...]
+
+For each Chrome-trace-event file (written by ``observe.Tracer``, or any
+trace the viewer loads): per-span totals (count, total/mean/max duration)
+and percentile tables over span durations. For each metrics.jsonl
+(``observe.MetricsLogger``): the latest counter values with compile /
+cache-hit accounting (hit rate, compile seconds by shape) and HBM peaks.
+
+Pure host-side: imports no jax, initializes no backend — it must run on a
+laptop against artifacts scp'd from a TPU host (the reason MetricsLogger
+grew its ``enabled=`` override). Exits 0 on success, 2 on unreadable
+input, 1 on no input files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from alphafold2_tpu.observe.histogram import Histogram
+from alphafold2_tpu.observe.tracing import load_trace_events
+
+
+def _fmt_s(seconds: float) -> str:
+    if seconds >= 1.0:
+        return f"{seconds:.3f}s"
+    return f"{seconds * 1e3:.2f}ms"
+
+
+def classify(path: str) -> str:
+    """"trace" (Chrome trace events) vs "metrics" (MetricsLogger JSONL):
+    trace files open with ``[`` or hold events with a ``ph`` key; metrics
+    lines are flat records with a ``step`` key."""
+    with open(path) as f:
+        head = f.read(4096).lstrip()
+    if head.startswith("["):
+        return "trace"
+    first = head.splitlines()[0] if head else "{}"
+    try:
+        rec = json.loads(first)
+    except json.JSONDecodeError:
+        return "trace"
+    return "trace" if "ph" in rec else "metrics"
+
+
+def report_trace(path: str) -> int:
+    events = load_trace_events(path)
+    spans = [e for e in events if e.get("ph") == "X"]
+    print(f"== trace {path}: {len(events)} events, {len(spans)} spans ==")
+    if not spans:
+        return 0
+    by_name: dict = {}
+    for e in spans:
+        by_name.setdefault(e["name"], []).append(e.get("dur", 0.0) / 1e6)
+
+    total_wall = sum(sum(v) for v in by_name.values())
+    print(f"{'span':<28} {'count':>6} {'total':>10} {'mean':>10} "
+          f"{'p50':>10} {'p95':>10} {'p99':>10} {'max':>10}")
+    for name in sorted(by_name, key=lambda n: -sum(by_name[n])):
+        durs = by_name[name]
+        h = Histogram()
+        for d in durs:
+            h.observe(d)
+        snap = h.snapshot()
+        print(
+            f"{name:<28} {len(durs):>6} {_fmt_s(sum(durs)):>10} "
+            f"{_fmt_s(sum(durs) / len(durs)):>10} "
+            f"{_fmt_s(snap['p50']):>10} {_fmt_s(snap['p95']):>10} "
+            f"{_fmt_s(snap['p99']):>10} {_fmt_s(max(durs)):>10}"
+        )
+    print(f"{'(span-seconds, nested spans double-count)':<28} "
+          f"{'':>6} {_fmt_s(total_wall):>10}")
+
+    compiles = [e for e in spans if e["name"].endswith("compile")]
+    if compiles:
+        print("-- compiles --")
+        for e in compiles:
+            args = e.get("args", {})
+            shape = ", ".join(f"{k}={v}" for k, v in sorted(args.items()))
+            print(f"  {e['name']}({shape}): {_fmt_s(e.get('dur', 0) / 1e6)}")
+    return 0
+
+
+def report_metrics(path: str) -> int:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    print(f"== metrics {path}: {len(records)} records ==")
+    latest: dict = {}
+    for rec in records:
+        for k, v in rec.items():
+            if k not in ("step", "time"):
+                latest[k] = v
+    for k in sorted(latest):
+        print(f"  {k} = {latest[k]}")
+
+    compiles = latest.get("serve.compiles", latest.get("compiles"))
+    hits = latest.get("serve.cache_hits", latest.get("cache_hits"))
+    if compiles is not None and hits is not None:
+        dispatches = compiles + hits
+        rate = hits / dispatches if dispatches else 0.0
+        print("-- compile/cache accounting --")
+        print(f"  executable builds: {compiles}")
+        print(f"  cache hits:        {hits}  "
+              f"(hit rate {rate:.1%} of {dispatches} lookups)")
+    if "hbm_peak_bytes" in latest:
+        print(f"-- memory --\n  HBM peak: "
+              f"{latest['hbm_peak_bytes'] / 2**30:.3f} GiB")
+    return 0
+
+
+def main(argv=None) -> int:
+    paths = [a for a in (argv if argv is not None else sys.argv[1:])
+             if not a.startswith("-")]
+    if not paths:
+        print(__doc__.strip().splitlines()[2].strip(), file=sys.stderr)
+        return 1
+    rc = 0
+    for path in paths:
+        try:
+            kind = classify(path)
+            (report_trace if kind == "trace" else report_metrics)(path)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"ERROR reading {path}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            rc = 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,90 @@
+"""bench.py liveness acceptance (ISSUE 2): with a simulated hung backend,
+the bench exits with a structured failure record marked ``liveness: dead``
+well inside the 60 s bound, instead of burning its whole deadline the way
+round 5 did (BENCH_r05.json: 1500 s hung in backend_init, no signal).
+
+The hang is simulated the same way tests/test_tpu_session_liveness.py does
+it — no real backend is harmed: AF2TPU_BENCH_SIMULATE_HANG sleeps inside
+the backend_init stage and AF2TPU_LIVENESS_PROBE_CODE makes the subprocess
+probe hang like a dead tunnel. Deadlines are scaled down so the test runs
+in seconds; the production defaults (30 s stage deadline + 25 s probe
+timeout) keep the same path under 60 s.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_liveness_dead_record_fast():
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        AF2TPU_PLATFORM="cpu",
+        # serve mode: no preflight probes in front of the measured path
+        AF2TPU_BENCH_MODE="serve",
+        AF2TPU_SERVE_BUCKETS="8",
+        AF2TPU_SERVE_REQUESTS="2",
+        # the simulated hang: backend_init sleeps far past every deadline
+        AF2TPU_BENCH_SIMULATE_HANG="backend_init:300",
+        AF2TPU_BENCH_INIT_DEADLINE="2",
+        AF2TPU_LIVENESS_TIMEOUT="3",
+        AF2TPU_LIVENESS_PROBE_CODE="import time; time.sleep(120)",
+    )
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=55, env=env,
+    )
+    elapsed = time.monotonic() - t0
+    # the acceptance bound, with margin: deadline 2s + probe 3s + overhead
+    assert elapsed < 55, elapsed
+
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, (r.stdout, r.stderr[-1000:])
+    record = json.loads(lines[0])
+    assert record["liveness"] == "dead"
+    assert record["stage"] == "serve:backend_init"
+    assert record["value"] == 0.0
+    assert record["vs_baseline_valid"] is False
+    assert "liveness dead" in record["error"]
+    assert "probe hung" in record["probe"]
+
+
+def test_default_deadlines_fit_the_60s_bound():
+    """The production path is stage deadline + probe timeout (+ poll/emit
+    overhead); the defaults must leave margin under the 60 s acceptance
+    bound so a real dead tunnel also fails fast."""
+    sys.path.insert(0, REPO)
+    import importlib
+
+    import bench
+
+    importlib.reload(bench)
+    probe_timeout = float(os.environ.get("AF2TPU_LIVENESS_TIMEOUT", 25))
+    assert bench.INIT_DEADLINE + probe_timeout <= 58
+
+
+def test_live_backend_is_not_killed(monkeypatch):
+    """A healthy-but-slow backend_init (probe passes) must survive the
+    stage deadline: the watchdog extends instead of firing."""
+    from alphafold2_tpu.observe import LivenessWatchdog
+
+    stage = {"name": "serve:backend_init"}
+    fired = []
+    wd = LivenessWatchdog(
+        stage_fn=lambda: stage["name"],
+        deadlines={"backend_init": 0.1},
+        on_dead=fired.append,
+        probe=lambda: (True, "probe ok"),
+        poll_s=0.02,
+    ).start()
+    time.sleep(0.5)  # several deadlines worth of "slow init"
+    stage["name"] = "serve:timed_run"  # init eventually completes
+    time.sleep(0.1)
+    wd.stop()
+    assert fired == []

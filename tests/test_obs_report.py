@@ -1,0 +1,137 @@
+"""obs_report CLI + bench serve-mode observability acceptance: the serve
+bench emits p50/p95/p99 from the streaming Histogram plus per-stage span
+timings; the trace file is valid Chrome trace-event JSON that
+scripts/obs_report.py summarizes with exit code 0."""
+
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def obs_report(monkeypatch):
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    sys.modules.pop("obs_report", None)
+    yield importlib.import_module("obs_report")
+    sys.modules.pop("obs_report", None)
+
+
+def _serve_env(monkeypatch, tmp_path):
+    for k, v in {
+        "AF2TPU_SERVE_BUCKETS": "8,16", "AF2TPU_SERVE_MAX_BATCH": "2",
+        "AF2TPU_SERVE_REQUESTS": "4", "AF2TPU_SERVE_DIM": "32",
+        "AF2TPU_SERVE_DEPTH": "1", "AF2TPU_SERVE_HEADS": "2",
+        "AF2TPU_SERVE_DIM_HEAD": "16", "AF2TPU_SERVE_MSA_DEPTH": "2",
+        "AF2TPU_SERVE_MDS_ITERS": "8",
+        "AF2TPU_TRACE_EVENTS": str(tmp_path / "trace.json"),
+        "AF2TPU_METRICS_DIR": str(tmp_path),
+    }.items():
+        monkeypatch.setenv(k, v)
+
+
+@pytest.fixture(scope="module")
+def serve_record(tmp_path_factory):
+    """One tiny serve bench run shared by the assertions below (module
+    scope: the run costs a couple of compiles)."""
+    tmp_path = tmp_path_factory.mktemp("obs")
+    mp = pytest.MonkeyPatch()
+    _serve_env(mp, tmp_path)
+    import bench
+
+    try:
+        record = bench.bench_serve(emit=False)
+    finally:
+        mp.undo()
+    return record, tmp_path
+
+
+def test_serve_record_has_histogram_percentiles(serve_record):
+    record, _ = serve_record
+    assert "error" not in record
+    # p50/p95/p99 from the streaming Histogram
+    assert record["p50_ms"] > 0
+    assert record["p50_ms"] <= record["p95_ms"] <= record["p99_ms"]
+    hists = record["histograms"]
+    for name in ("latency_ms", "queue_wait_ms", "dispatch_ms",
+                 "batch_occupancy", "pad_ratio"):
+        assert name in hists, name
+    assert hists["latency_ms"]["count"] == 4  # one sample per request
+    assert round(hists["latency_ms"]["p50"], 1) == record["p50_ms"]
+    assert 0 < hists["batch_occupancy"]["max"] <= 1.0
+    assert 0 <= hists["pad_ratio"]["max"] < 1.0
+    # compile durations keyed by executable shape
+    shapes = {(c["bucket"], c["batch"]) for c in record["compile_records"]}
+    assert shapes == {(8, 2), (16, 2)}
+    assert all(c["seconds"] > 0 for c in record["compile_records"])
+
+
+def test_serve_record_has_per_stage_spans(serve_record):
+    record, _ = serve_record
+    spans = record["spans"]
+    for name in ("bench.serve:backend_init", "bench.serve:trace_compile",
+                 "bench.serve:timed_run", "serve.featurize",
+                 "serve.dispatch", "serve.device_get", "serve.unpad",
+                 "serve.compile"):
+        assert name in spans, (name, sorted(spans))
+        assert spans[name]["count"] >= 1
+        assert spans[name]["total_s"] >= 0.0
+    assert spans["serve.compile"]["count"] == record["compiles"]
+
+
+def test_serve_trace_file_is_valid_chrome_format(serve_record):
+    from alphafold2_tpu.observe.tracing import load_trace_events
+
+    _, tmp_path = serve_record
+    path = tmp_path / "trace.json"
+    assert path.exists()
+    events = load_trace_events(str(path))
+    assert events
+    for e in events:
+        assert e["ph"] in ("X", "i", "C")
+        assert isinstance(e["name"], str)
+        assert isinstance(e["ts"], (int, float))
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    # the request lifecycle is all present
+    names = {e["name"] for e in events if e["ph"] == "X"}
+    assert {"serve.featurize", "serve.dispatch", "serve.device_get",
+            "serve.unpad", "serve.compile"} <= names
+
+
+def test_obs_report_summarizes_serve_artifacts(
+    serve_record, obs_report, capsys
+):
+    _, tmp_path = serve_record
+    rc = obs_report.main(
+        [str(tmp_path / "trace.json"), str(tmp_path / "metrics.jsonl")]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "serve.dispatch" in out
+    assert "p95" in out
+    assert "compile/cache accounting" in out
+    assert "executable builds: 2" in out
+
+
+def test_obs_report_exit_codes(obs_report, tmp_path, capsys):
+    assert obs_report.main([]) == 1  # no inputs: usage error
+    bad = tmp_path / "nope.json"
+    assert obs_report.main([str(bad)]) == 2  # unreadable input
+    capsys.readouterr()
+
+
+def test_obs_report_reads_standalone_metrics(obs_report, tmp_path, capsys):
+    from alphafold2_tpu.observe import MetricsLogger
+
+    logger = MetricsLogger(str(tmp_path), enabled=True, echo=False)
+    logger.log(0, {"serve.compiles": 3, "serve.cache_hits": 9,
+                   "hbm_peak_bytes": 2**30})
+    assert obs_report.main([str(tmp_path / "metrics.jsonl")]) == 0
+    out = capsys.readouterr().out
+    assert "hit rate 75.0%" in out
+    assert "HBM peak: 1.000 GiB" in out

@@ -134,7 +134,9 @@ def test_chunked_clash_matches_dense():
     big = jnp.concatenate([bb + 500.0 * i for i in range(30)], axis=1)  # 1800
     assert big.shape[1] > 1536
     e_big = float(backbone_energy(big, big)[0])  # lax.map chunked path
-    np.testing.assert_allclose(e_big, 30 * e_small, rtol=1e-4)
+    # 3e-4: float32 accumulation order differs between the dense reduction
+    # and the chunked lax.map sum (observed 1.02e-4 on some BLAS builds)
+    np.testing.assert_allclose(e_big, 30 * e_small, rtol=3e-4)
 
 
 def test_icode_residues_preserved(tmp_path):

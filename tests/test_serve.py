@@ -205,6 +205,67 @@ def test_warmup_precompiles_ladder():
     assert s["serve.cache_hits"] == 2
 
 
+# ----------------------------------------------------------- observability
+
+
+def test_engine_histograms_and_compile_records():
+    """The engine streams latency/queue-wait/dispatch/occupancy/pad-ratio
+    distributions and records per-(bucket,batch) compile durations."""
+    eng = ServeEngine(_cfg())
+    res = eng.predict_many(["ACDE", "ACDEF", "ACDEFG", "ACDEFGHKLMNP"])
+    h = eng.histograms
+    assert h["latency_s"].count == 4  # one observation per request
+    # one per dispatch: 3 reqs in the 8-bucket (one full batch) + 1 in 16
+    assert h["queue_wait_s"].count == 2
+    assert h["dispatch_s"].count == 2
+    assert h["batch_occupancy"].count == 2
+    assert h["pad_ratio"].count == 4
+    assert 0 < h["batch_occupancy"].snapshot()["max"] <= 1.0
+    # latency decomposes: queue wait + dispatch, and both ride the result
+    for r in res:
+        assert r.latency_s > 0
+        assert abs(r.latency_s - (r.queue_wait_s + r.dispatch_s)) < 1e-9
+    shapes = {(c["bucket"], c["batch"]) for c in eng.compile_records}
+    assert shapes == {(8, 3), (16, 3)}
+    assert all(c["seconds"] > 0 for c in eng.compile_records)
+    assert len(eng.compile_records) == eng.stats()["serve.compiles"]
+
+
+def test_engine_traces_request_lifecycle(tmp_path):
+    """With a tracer attached, one dispatch emits the full span lifecycle
+    (featurize -> get_executable/compile -> dispatch -> device_get ->
+    unpad) in valid Chrome trace-event form."""
+    from alphafold2_tpu.observe import Tracer
+    from alphafold2_tpu.observe.tracing import load_trace_events
+
+    path = str(tmp_path / "serve_trace.json")
+    tracer = Tracer(path)
+    eng = ServeEngine(_cfg(buckets=(8,), max_batch=2), tracer=tracer)
+    eng.predict_many(["ACDEFG", "MKVLIT", "AC"])
+    tracer.close()
+
+    events = load_trace_events(path)
+    spans = [e for e in events if e["ph"] == "X"]
+    names = [e["name"] for e in spans]
+    for expected in ("serve.batch", "serve.featurize",
+                     "serve.get_executable", "serve.compile",
+                     "serve.dispatch", "serve.device_get", "serve.unpad"):
+        assert expected in names, (expected, sorted(set(names)))
+    assert names.count("serve.batch") == 2  # 3 requests / max_batch 2
+    assert names.count("serve.compile") == 1  # second dispatch cache-hits
+    # cache verdict is attached to the get_executable spans
+    verdicts = [
+        e["args"]["compiled_now"] for e in spans
+        if e["name"] == "serve.get_executable"
+    ]
+    assert verdicts == [True, False]
+    # spans nest inside their serve.batch parent on the same thread
+    batch0 = next(e for e in spans if e["name"] == "serve.batch")
+    feat0 = next(e for e in spans if e["name"] == "serve.featurize")
+    assert batch0["ts"] <= feat0["ts"]
+    assert feat0["ts"] + feat0["dur"] <= batch0["ts"] + batch0["dur"] + 1
+
+
 # ------------------------------------------------------------------- bench
 
 
