@@ -125,6 +125,12 @@ class TrainConfig:
     # observe.Tracer span output (Chrome trace-event JSONL, Perfetto-
     # loadable): per-step host-side spans beside the XLA profile above
     trace_events: Optional[str] = None
+    # in-graph numerics telemetry (observe.numerics): "off" | "triage"
+    # (per-parameter-group norms every step; on a non-finite-grad skip,
+    # rerun the step fully tagged and report the first bad tensor) |
+    # "full" (tagged activation stats on every step). AF2TPU_NUMERICS
+    # env var overrides per run.
+    numerics: str = "triage"
 
 
 def _tuplify(section, name):

@@ -35,6 +35,7 @@ from flax import linen as nn
 
 from alphafold2_tpu import constants
 from alphafold2_tpu.models.trunk import Trunk
+from alphafold2_tpu.observe.numerics import tag
 from alphafold2_tpu.ops.attention import Attention, AxialAttention, FeedForward
 from alphafold2_tpu.parallel.sharding import shard_msa, shard_pair
 from alphafold2_tpu.utils.structure import get_bucketed_distance_matrix
@@ -193,7 +194,7 @@ class Alphafold2(nn.Module):
         e = token_emb(seq)  # (B, N, D)
         x = e[:, :, None, :] + e[:, None, :, :]
         x = x + pos_emb(n_range)[None, :, None, :] + pos_emb_ax(n_range)[None, None, :, :]
-        x = shard_pair(x)
+        x = tag("embed.pair", shard_pair(x))
 
         pair_mask = None
         if mask is not None:
@@ -221,7 +222,7 @@ class Alphafold2(nn.Module):
             if mask is not None:
                 m_mask = mask[:, :, None] & mask[:, None, :]
         if m is not None:
-            m = shard_msa(m, rows=self.msa_row_shard)
+            m = tag("embed.msa", shard_msa(m, rows=self.msa_row_shard))
 
         # template stream
         if templates_seq is not None:
@@ -314,4 +315,4 @@ class Alphafold2(nn.Module):
         x = 0.5 * (x + jnp.swapaxes(x, 1, 2))
         x = nn.LayerNorm(dtype=dt, name="distogram_norm")(x)
         logits = nn.Dense(constants.DISTOGRAM_BUCKETS, dtype=dt, name="distogram_proj")(x)
-        return logits.astype(jnp.float32)
+        return tag("distogram.logits", logits.astype(jnp.float32))

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from alphafold2_tpu.observe.numerics import tag
 from alphafold2_tpu.ops.attention import Attention, AxialAttention, FeedForward
 from alphafold2_tpu.parallel.sharding import shard_pair, shard_msa
 
@@ -339,7 +340,7 @@ class Trunk(nn.Module):
                     "benefit silently lost); use remat=True with "
                     "grid_parallel"
                 )
-            return ReversibleTrunk(
+            x, m = ReversibleTrunk(
                 dim=self.dim,
                 depth=self.depth,
                 heads=self.heads,
@@ -358,6 +359,12 @@ class Trunk(nn.Module):
                 name="reversible",
             )(x, m, pair_mask=pair_mask, msa_mask=msa_mask,
               deterministic=deterministic)
+            # numerics tags only at the engine boundary: tagging inside the
+            # scanned/custom-backward body would capture inner-trace tracers
+            x = tag("trunk.out.pair", x)
+            if m is not None:
+                m = tag("trunk.out.msa", m)
+            return x, m
 
         if self.scan_layers:
             if len(set(sparse_flags)) > 1:
@@ -380,6 +387,11 @@ class Trunk(nn.Module):
                 name="scan",
             )
             (x, m), _ = scanned((x, m), pair_mask, msa_mask)
+            # per-layer tags would sit inside the scan body (inner tracers);
+            # the scanned engine tags at the trunk boundary only
+            x = tag("trunk.out.pair", x)
+            if m is not None:
+                m = tag("trunk.out.msa", m)
             return x, m
 
         layer_cls = TrunkLayer
@@ -393,4 +405,10 @@ class Trunk(nn.Module):
             x, m = layer_cls(
                 **self._layer_kwargs(sparse), name=f"layer_{i}"
             )(x, m, pair_mask, msa_mask, deterministic)
+            # layer-boundary numerics tags: OUTSIDE the (possibly remat'ed)
+            # layer body, so the stats are outer-trace values in every
+            # engine mode; tag order == depth order == topological order
+            x = tag(f"trunk.layer_{i}.pair", x)
+            if m is not None:
+                m = tag(f"trunk.layer_{i}.msa", m)
         return x, m

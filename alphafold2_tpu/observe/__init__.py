@@ -21,14 +21,27 @@ first-class answer:
   in seconds instead of eating a whole deadline.
 - :mod:`profiler` — ``Profiler``: jax.profiler XLA trace over a step
   window (TensorBoard/XProf), unchanged from the original train hook.
+- :mod:`numerics` — in-graph per-tensor telemetry: ``tag(name, x)``
+  collects L2/max-abs/NaN/Inf stats as auxiliary jit outputs (zero ops
+  when disabled), powering the train loop's NaN-triage reports.
+- :mod:`flops` — the tree's single ``cost_analysis()`` parser: flops /
+  bytes per compiled executable, peak-FLOPs tables and uniform MFU for
+  bench, serve and the microbenchmarks.
+- :mod:`regress` — device-keyed perf regression gate over bench/serve
+  records (``scripts/bench_compare.py`` is the CLI/CI entry point).
 
 ``alphafold2_tpu.train.observe`` remains as a re-export shim for existing
 imports. ``scripts/obs_report.py`` summarizes the emitted artifacts.
+
+Everything here is importable without a jax backend (jax is imported
+lazily where a device is consulted), so host-side tools stay jax-free.
 """
 
+from alphafold2_tpu.observe import flops, numerics, regress
 from alphafold2_tpu.observe.histogram import Histogram
 from alphafold2_tpu.observe.memory import MemorySampler
 from alphafold2_tpu.observe.metrics import EventCounters, MetricsLogger
+from alphafold2_tpu.observe.numerics import tag
 from alphafold2_tpu.observe.profiler import Profiler
 from alphafold2_tpu.observe.tracing import Span, Tracer
 from alphafold2_tpu.observe.watchdog import LivenessWatchdog, probe_backend
@@ -42,5 +55,9 @@ __all__ = [
     "Profiler",
     "Span",
     "Tracer",
+    "flops",
+    "numerics",
     "probe_backend",
+    "regress",
+    "tag",
 ]

@@ -1,0 +1,101 @@
+"""Unified FLOPs/bytes accounting and MFU: the tree's ONE cost_analysis parser.
+
+``compiled.cost_analysis()`` parsing used to be duplicated ad hoc in
+``bench.py`` and ``scripts/bisect_perf.py``; every consumer (the train
+bench, the serve engine's ``compile_records``, the train loop's metrics and
+the microbenchmarks) now sources flops/bytes/MFU from here, so the peak
+tables and the plausibility ceiling cannot drift apart between call sites.
+
+jax is imported lazily (only where a device is actually consulted) so the
+module rides along with ``alphafold2_tpu.observe`` imports in host-side
+tools without touching a backend.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# published peak dense bf16 FLOPs/s per chip (v5e's oft-quoted 394 is int8)
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6e": 918e12,
+}
+
+# no production chip sustains 2 PFLOP/s dense bf16 today (v6e peaks at
+# 918 TF); a measurement implying more is a broken clock on ANY device,
+# known or not — the unknown-device fallback for the implausibility guard
+SANITY_FLOPS_CEILING = 2e15
+
+
+def cost_analysis(compiled) -> dict:
+    """Normalized XLA cost-analysis properties of a compiled executable.
+
+    Handles the older-jax list-of-per-device-dicts form; returns ``{}`` when
+    the backend exposes nothing (cost analysis is best-effort and must never
+    break a measurement)."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return dict(cost) if cost else {}
+    except Exception:
+        return {}
+
+
+def executable_costs(compiled) -> dict:
+    """``{"flops": float|None, "bytes_accessed": float|None}`` for one
+    compiled executable (None = the backend exposes no such count)."""
+    cost = cost_analysis(compiled)
+    flops = float(cost.get("flops", 0.0))
+    bytes_accessed = float(cost.get("bytes accessed", 0.0))
+    return {
+        "flops": flops if flops > 0 else None,
+        "bytes_accessed": bytes_accessed if bytes_accessed > 0 else None,
+    }
+
+
+def step_flops(compiled) -> Optional[float]:
+    """The compiled program's own FLOP count from XLA cost analysis; None
+    when the backend exposes none."""
+    return executable_costs(compiled)["flops"]
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    """Published peak dense bf16 FLOPs/s of ``device`` (default: the first
+    jax device); None for chips the table does not know (CPUs included)."""
+    try:
+        if device is None:
+            import jax
+
+            device = jax.devices()[0]
+        kind = device.device_kind
+        return next(
+            (v for k, v in PEAK_FLOPS.items() if k.lower() in kind.lower()),
+            None,
+        )
+    except Exception:
+        return None
+
+
+def mfu(
+    flops: Optional[float],
+    seconds: float,
+    device=None,
+    peak: Optional[float] = None,
+) -> Optional[float]:
+    """Model FLOPs utilization: ``flops / seconds / peak``. None when the
+    flop count or the chip's peak is unknown."""
+    if not flops or not seconds or seconds <= 0:
+        return None
+    peak = peak if peak is not None else device_peak_flops(device)
+    if not peak:
+        return None
+    return flops / seconds / peak
+
+
+def estimate_mfu(compiled, step_seconds: float) -> Optional[float]:
+    """MFU of one executed step of ``compiled`` taking ``step_seconds``."""
+    return mfu(step_flops(compiled), step_seconds)

@@ -75,6 +75,27 @@ class MetricsLogger:
                 f.write(line + "\n")
 
 
+def flatten_metrics(metrics: dict, prefix: str = "", sep: str = "/") -> dict:
+    """Flatten nested metric dicts into ``a/b/c`` float keys.
+
+    The train loops log through this so structured step metrics (the
+    numerics stats tree, per-parameter-group norms) land in metrics.jsonl
+    as flat greppable keys. Leaves are coerced with ``float()`` — which
+    also fetches device scalars — falling back to the raw value for
+    non-numeric leaves (strings)."""
+    out: dict = {}
+    for k, v in metrics.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(flatten_metrics(v, prefix=key + sep, sep=sep))
+            continue
+        try:
+            out[key] = float(v)
+        except (TypeError, ValueError):
+            out[key] = v
+    return out
+
+
 class EventCounters:
     """Named monotonic counters for process-local accounting (compile
     counts, cache hits, request totals). Same spirit as MetricsLogger but

@@ -1,0 +1,211 @@
+"""In-graph numerics telemetry: per-tensor stats as auxiliary jit outputs.
+
+The jitted programs are otherwise a black box: when the train step detects
+non-finite gradients it skips the update, but nothing says WHICH tensor went
+non-finite first, and nothing records the activation/gradient/param-norm
+trajectories that show a run going unhealthy before it diverges. This module
+is the in-graph half of the answer:
+
+- ``tag(name, x)`` is an identity that, while a :func:`collect` context is
+  active on the tracing thread, records per-tensor statistics (L2 norm,
+  max-abs over finite entries, NaN/Inf counts). Tags are permanently wired
+  through the model (trunk layer boundaries, embeddings, the distogram
+  head and loss) and cost **zero ops** when no collector is active — the
+  jaxpr is identical to untagged code, so instrumentation can ship in hot
+  paths.
+- Collection must live INSIDE the traced function (stats become part of its
+  returned pytree, typically via ``value_and_grad(..., has_aux=True)``);
+  ``jax.jit`` caches by function identity, so a tagged and an untagged step
+  must be two different functions — see ``train.loop.make_train_step``
+  (``numerics="full"``) and ``make_triage_step``.
+- Tag order is trace-execution order, i.e. topological order of the
+  program: :func:`first_nonfinite` over a stats dict names the first tensor
+  that went bad, which is what the NaN-triage report is built on.
+
+Host-side helpers (:func:`triage_report`, :func:`flatten_stats`,
+:func:`counters_to_tracer`) push the same ``numerics/<name>/<stat>``
+vocabulary into ``MetricsLogger`` JSONL and the ``Tracer`` span stream, so
+``metrics.jsonl`` and a Perfetto trace describe tensors with the same names.
+
+jax is imported lazily so ``alphafold2_tpu.observe`` stays importable by
+host-side tools (``scripts/obs_report.py``) without a jax backend.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+STAT_KEYS = ("l2", "max_abs", "nan_count", "inf_count")
+
+
+def tensor_stats(x) -> dict:
+    """Per-tensor health statistics, computed in float32.
+
+    ``l2`` and ``max_abs`` are over the FINITE entries only (a single Inf
+    would otherwise wash out the magnitude signal); non-finites are counted
+    separately in ``nan_count`` / ``inf_count``.
+    """
+    import jax.numpy as jnp
+
+    xf = jnp.asarray(x).astype(jnp.float32)
+    finite = jnp.isfinite(xf)
+    safe = jnp.where(finite, xf, 0.0)
+    return {
+        "l2": jnp.sqrt(jnp.sum(safe * safe)),
+        "max_abs": jnp.max(jnp.abs(safe), initial=0.0),
+        "nan_count": jnp.sum(jnp.isnan(xf)).astype(jnp.int32),
+        "inf_count": jnp.sum(jnp.isinf(xf)).astype(jnp.int32),
+    }
+
+
+def tree_stats(tree) -> dict:
+    """:func:`tensor_stats` over a whole pytree (e.g. one parameter group's
+    gradients): l2 combines as a global norm, max/counts combine across
+    leaves."""
+    import jax
+    import jax.numpy as jnp
+
+    per = [tensor_stats(leaf) for leaf in jax.tree.leaves(tree)]
+    if not per:
+        z = jnp.zeros((), jnp.float32)
+        return {"l2": z, "max_abs": z,
+                "nan_count": z.astype(jnp.int32),
+                "inf_count": z.astype(jnp.int32)}
+    return {
+        "l2": jnp.sqrt(sum(s["l2"] ** 2 for s in per)),
+        "max_abs": jnp.max(jnp.stack([s["max_abs"] for s in per])),
+        "nan_count": sum(s["nan_count"] for s in per),
+        "inf_count": sum(s["inf_count"] for s in per),
+    }
+
+
+class Collector:
+    """Accumulates ``{name: tensor_stats}`` in tag order. Repeated names
+    (a module applied twice in one trace) are disambiguated as ``name#2``,
+    ``name#3``, ... Each entry carries an explicit ``index`` (its tag
+    position): jax sorts dict keys at the jit boundary, so python dict
+    insertion order does NOT survive a jitted return — the index is what
+    preserves topological order for :func:`first_nonfinite`."""
+
+    def __init__(self):
+        self._stats: dict = {}
+
+    def record(self, name: str, x) -> None:
+        base, n = name, 1
+        while name in self._stats:
+            n += 1
+            name = f"{base}#{n}"
+        self._stats[name] = {"index": len(self._stats), **tensor_stats(x)}
+
+    def stats(self) -> dict:
+        return dict(self._stats)
+
+
+class _ThreadState(threading.local):
+    collector: Optional[Collector] = None
+
+
+_STATE = _ThreadState()
+
+
+def tag(name: str, x):
+    """Identity on ``x``; records its stats when collection is active on
+    this (tracing) thread. Safe to leave permanently in model code — with
+    no active collector it adds nothing to the jaxpr."""
+    col = _STATE.collector
+    if col is not None:
+        col.record(name, x)
+    return x
+
+
+@contextmanager
+def collect(enabled: bool = True):
+    """Activate stat collection for tags fired within the block.
+
+    Must be entered INSIDE the function being traced, with the collector's
+    ``stats()`` included in that function's return value — stats are traced
+    arrays and cannot escape the trace any other way. ``enabled=False``
+    yields an inert collector (``stats() == {}``) so call sites can keep a
+    single code path.
+    """
+    if not enabled:
+        yield Collector()
+        return
+    prev = _STATE.collector
+    col = Collector()
+    _STATE.collector = col
+    try:
+        yield col
+    finally:
+        _STATE.collector = prev
+
+
+# --------------------------------------------------------------- host side
+
+
+def stats_to_host(stats: dict) -> dict:
+    """Device/traced scalars -> plain python floats (fetches values)."""
+    return {
+        name: {k: float(v) for k, v in s.items()}
+        for name, s in stats.items()
+    }
+
+
+def _ordered(stats: dict):
+    """Items in topological (tag) order via the recorded ``index`` — dict
+    order is unreliable after a round-trip through jit's sorted pytrees."""
+    return sorted(
+        stats.items(), key=lambda kv: float(kv[1].get("index", 0))
+    )
+
+
+def first_nonfinite(stats: dict) -> Optional[str]:
+    """Name of the first tensor (in tag = topological order) with any
+    NaN/Inf entries; None when everything is finite."""
+    for name, s in _ordered(stats):
+        if float(s.get("nan_count", 0)) or float(s.get("inf_count", 0)):
+            return name
+    return None
+
+
+def flatten_stats(stats: dict, prefix: str = "numerics") -> dict:
+    """``{"numerics/<name>/<stat>": float}`` — the flat vocabulary shared by
+    metrics.jsonl records and trace counter events (the ordering ``index``
+    is bookkeeping, not a metric, and is dropped)."""
+    return {
+        f"{prefix}/{name}/{k}": float(v)
+        for name, s in stats.items()
+        for k, v in s.items()
+        if k != "index"
+    }
+
+
+def triage_report(stats: dict, step: Optional[int] = None) -> dict:
+    """Structured NaN-triage record: which tensor went non-finite first
+    (topological order), every non-finite tensor, and the full stat table."""
+    host = stats_to_host(stats)
+    bad = [
+        name for name, s in _ordered(host)
+        if s.get("nan_count") or s.get("inf_count")
+    ]
+    return {
+        "event": "nan_triage",
+        **({"step": int(step)} if step is not None else {}),
+        "first_nonfinite": bad[0] if bad else None,
+        "nonfinite": bad,
+        "tensors": host,
+    }
+
+
+def counters_to_tracer(stats: dict, tracer, prefix: str = "numerics") -> None:
+    """Emit one Chrome trace counter event per tagged tensor, same
+    ``numerics/<name>`` vocabulary as :func:`flatten_stats`."""
+    if tracer is None or not getattr(tracer, "enabled", False):
+        return
+    for name, s in stats.items():
+        tracer.counter(
+            f"{prefix}/{name}",
+            **{k: float(v) for k, v in s.items() if k != "index"},
+        )

@@ -1,0 +1,178 @@
+"""Device-keyed perf regression gate over bench/serve records.
+
+Five bench rounds produced records (``BENCH_r01..r05.json``) that were all
+invalid tunnel-hang diagnostics, and nothing automated ever compared a new
+number against the committed baselines — the ROADMAP's "fast as the
+hardware allows" north star had no machinery that notices a regression.
+This module is that machinery, shared by ``scripts/bench_compare.py`` (the
+CI gate) and anything else that wants a verdict:
+
+- **validity** — :func:`record_invalid_reason` distinguishes a real
+  measurement from the failure shapes the bench deliberately emits
+  (``error`` records, ``implausible``/``clock_suspect`` clock failures,
+  value-0.0 watchdog records, withdrawn baselines).
+- **comparability** — :func:`comparable_reason` requires the same metric
+  label, the same device kind (a CPU-mesh number vs a TPU number is not a
+  comparison) and, for train-bench records, the same in-graph step count
+  (the timing methodology).
+- **thresholds** — per-metric direction + tolerated fractional change;
+  anything past tolerance in the bad direction regresses the verdict.
+
+The output is a structured ``pass`` / ``regress`` / ``no-data`` verdict:
+``no-data`` (invalid or incomparable records, missing baseline) is an
+explicit third state so a broken bench can never silently read as "at
+parity". Pure python, no jax — runs host-side in CI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# name -> (direction, tolerated fractional change vs baseline). "higher"
+# means bigger is better (regress when current < (1 - tol) * baseline);
+# "lower" means smaller is better (regress when current > (1 + tol) *
+# baseline). Latency tolerances are generous: CI runners and the CPU mesh
+# are noisy, and the gate must catch real cliffs, not scheduler jitter.
+DEFAULT_THRESHOLDS = {
+    "value": ("higher", 0.10),
+    "mfu": ("higher", 0.15),
+    "p50_ms": ("lower", 0.50),
+    "p95_ms": ("lower", 0.50),
+    "p99_ms": ("lower", 0.50),
+}
+
+
+def record_invalid_reason(rec) -> Optional[str]:
+    """Why this record is NOT a usable measurement (None = it is)."""
+    if not isinstance(rec, dict):
+        return "not a record object"
+    if rec.get("error"):
+        return f"error record ({str(rec['error'])[:120]})"
+    if rec.get("invalid"):
+        return "withdrawn/invalid record"
+    if rec.get("implausible"):
+        return "implausible measurement (clock not syncing with device)"
+    if rec.get("clock_suspect"):
+        return "clock_suspect measurement (probe failed)"
+    if rec.get("liveness") == "dead":
+        return "liveness-dead failure record"
+    if not rec.get("value"):
+        return "no measured value"
+    return None
+
+
+def comparable_reason(current: dict, baseline: dict) -> Optional[str]:
+    """Why these two valid records must not be compared (None = they may).
+
+    Comparisons are keyed by metric label (which encodes the measured
+    config), device kind, and — for train-bench records — the in-graph step
+    count, since changing any of those changes what the number means."""
+    if current.get("metric") != baseline.get("metric"):
+        return (
+            f"metric label mismatch: current={current.get('metric')!r} "
+            f"baseline={baseline.get('metric')!r}"
+        )
+    cur_dev, base_dev = current.get("device"), baseline.get("device")
+    if cur_dev and base_dev and cur_dev != base_dev:
+        return f"device mismatch: current={cur_dev!r} baseline={base_dev!r}"
+    if "ingraph" in baseline and baseline.get("ingraph") != current.get(
+        "ingraph"
+    ):
+        return (
+            f"timing methodology mismatch: ingraph current="
+            f"{current.get('ingraph')} baseline={baseline.get('ingraph')}"
+        )
+    return None
+
+
+def _compare_one(name, cur, base, direction, tolerance) -> dict:
+    ratio = cur / base if base else None
+    if ratio is None:
+        ok = True  # zero/absent baseline value: nothing to gate on
+    elif direction == "higher":
+        ok = ratio >= 1.0 - tolerance
+    else:
+        ok = ratio <= 1.0 + tolerance
+    return {
+        "name": name,
+        "current": cur,
+        "baseline": base,
+        "ratio": round(ratio, 4) if ratio is not None else None,
+        "direction": direction,
+        "tolerance": tolerance,
+        "ok": bool(ok),
+    }
+
+
+def compare(
+    current: dict,
+    baseline: Optional[dict],
+    thresholds: Optional[dict] = None,
+) -> dict:
+    """Structured verdict of ``current`` against ``baseline``.
+
+    Returns ``{"verdict": "pass"|"regress"|"no-data", ...}`` with a
+    ``reason`` for no-data and per-metric ``comparisons`` otherwise. Only
+    metrics present in BOTH records and named in ``thresholds`` are gated.
+    """
+    thresholds = thresholds if thresholds is not None else DEFAULT_THRESHOLDS
+    out = {
+        "metric": current.get("metric") if isinstance(current, dict) else None,
+        "device": current.get("device") if isinstance(current, dict) else None,
+    }
+
+    reason = record_invalid_reason(current)
+    if reason is not None:
+        return {**out, "verdict": "no-data",
+                "reason": f"current record invalid: {reason}"}
+    if baseline is None:
+        return {**out, "verdict": "no-data", "reason": "missing baseline"}
+    reason = record_invalid_reason(baseline)
+    if reason is not None:
+        return {**out, "verdict": "no-data",
+                "reason": f"baseline record invalid: {reason}"}
+    reason = comparable_reason(current, baseline)
+    if reason is not None:
+        return {**out, "verdict": "no-data",
+                "reason": f"not comparable: {reason}"}
+
+    comparisons = []
+    for name, (direction, tolerance) in thresholds.items():
+        cur, base = current.get(name), baseline.get(name)
+        if not isinstance(cur, (int, float)) or not isinstance(
+            base, (int, float)
+        ):
+            continue
+        comparisons.append(
+            _compare_one(name, float(cur), float(base), direction, tolerance)
+        )
+    if not comparisons:
+        return {**out, "verdict": "no-data",
+                "reason": "no shared gated metrics between the records"}
+    regressions = [c["name"] for c in comparisons if not c["ok"]]
+    return {
+        **out,
+        "verdict": "regress" if regressions else "pass",
+        "comparisons": comparisons,
+        "regressions": regressions,
+    }
+
+
+def parse_threshold_overrides(items, base: Optional[dict] = None) -> dict:
+    """CLI ``metric=tolerance`` (keep the default direction) or
+    ``metric=direction:tolerance`` overrides onto a copy of the defaults."""
+    out = dict(base if base is not None else DEFAULT_THRESHOLDS)
+    for item in items or ():
+        name, _, spec = item.partition("=")
+        if not spec:
+            raise ValueError(
+                f"bad threshold {item!r}; expected metric=tol or "
+                "metric=direction:tol"
+            )
+        direction, _, tol = spec.rpartition(":")
+        if not direction:
+            direction = out.get(name, ("higher", 0.0))[0]
+        if direction not in ("higher", "lower"):
+            raise ValueError(f"bad direction {direction!r} in {item!r}")
+        out[name] = (direction, float(tol))
+    return out

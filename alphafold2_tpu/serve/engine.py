@@ -48,6 +48,7 @@ from alphafold2_tpu.observe import (
     MemorySampler,
     Tracer,
 )
+from alphafold2_tpu.observe.flops import executable_costs
 from alphafold2_tpu.predict import encode_sequence
 from alphafold2_tpu.serve.bucketing import bucket_for, validate_ladder
 from alphafold2_tpu.train.end2end import End2EndModel
@@ -137,6 +138,10 @@ class ServeEngine:
             "pad_ratio": Histogram(),
         }
         self.compile_records: list = []
+        # flops of every executed dispatch (observe.flops cost analysis of
+        # the executable that carried it): the serve bench's MFU numerator
+        self.executed_flops: float = 0.0
+        self._exe_flops: dict = {}
         self.model = End2EndModel(
             dim=cfg.model.dim, depth=cfg.model.depth, heads=cfg.model.heads,
             dim_head=cfg.model.dim_head, max_seq_len=cfg.model.max_seq_len,
@@ -236,9 +241,14 @@ class ServeEngine:
                     .compile()
                 )
         self.counters.bump("serve.compiles")
+        costs = executable_costs(compiled)  # flops/bytes via observe.flops
+        self._exe_flops[key] = costs["flops"] or 0.0
         self.compile_records.append({
             "bucket": bucket, "batch": batch,
             "seconds": round(time.perf_counter() - t0, 4),
+            **({"flops": costs["flops"]} if costs["flops"] else {}),
+            **({"bytes_accessed": costs["bytes_accessed"]}
+               if costs["bytes_accessed"] else {}),
         })
         self._executables[key] = compiled
         return compiled
@@ -350,6 +360,7 @@ class ServeEngine:
             dispatch_s = time.perf_counter() - t0
             batch_span.set(dispatch_s=round(dispatch_s, 4))
             self.histograms["dispatch_s"].observe(dispatch_s)
+            self.executed_flops += self._exe_flops.get((bucket, batch), 0.0)
             self.memory.counter_to(self.tracer)  # HBM beside the spans
 
             with self.tracer.span("serve.unpad", bucket=bucket):

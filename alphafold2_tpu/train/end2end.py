@@ -309,15 +309,23 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
     prefetched = device_prefetch(chain([sample], data_iter), mesh)
     batch = next(prefetched)
     t0 = time.perf_counter()
+    last_logged = None
     for i in range(start_step, num_steps):
         rng, r = jax.random.split(rng)
         state, metrics = step_fn(state, batch, r)
-        if (i + 1) % cfg.train.log_every == 0 or i == 0:
-            m = {k: float(v) for k, v in metrics.items()}
-            m["steps_per_sec"] = (
-                cfg.train.log_every / (time.perf_counter() - t0) if i else 0.0
-            )
-            t0 = time.perf_counter()
+        if (i + 1) % cfg.train.log_every == 0 or i == start_step:
+            from alphafold2_tpu.observe.metrics import flatten_metrics
+
+            m = flatten_metrics(metrics)
+            now = time.perf_counter()
+            if last_logged is None:
+                # compile-dominated first step: its wall time is a metric of
+                # its own, not a bogus steps_per_sec=0.0 placeholder
+                m["first_step_s"] = round(now - t0, 4)
+            else:
+                m["steps_per_sec"] = (i - last_logged) / max(now - t0, 1e-9)
+            last_logged = i
+            t0 = now
             logger.log(i, m)
         if ckpt is not None and (i + 1) % cfg.train.checkpoint_every == 0:
             ckpt.save(i + 1, state)

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from alphafold2_tpu.config import Config
 from alphafold2_tpu.models.alphafold2 import Alphafold2
+from alphafold2_tpu.observe import numerics
 from alphafold2_tpu.parallel.sharding import DATA_AXIS, use_mesh
 from alphafold2_tpu.utils.structure import get_bucketed_distance_matrix
 
@@ -48,6 +49,10 @@ def distogram_cross_entropy(
     safe_labels = jnp.where(valid, labels, 0)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    # numerics tag (no-op unless collection is active): the loss is the
+    # last forward tensor, so a first-NaN here means the loss itself, not
+    # the trunk, went bad
+    nll = numerics.tag("loss.distogram_nll", nll)
     return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
 
 
@@ -220,8 +225,21 @@ def tiny_init_state(
     return init_state(cfg, model, batch)
 
 
+def _param_groups(tree) -> dict:
+    """Split a param/grad tree into its top-level module groups (``trunk``,
+    ``token_emb``, ...), unwrapping the flax ``params`` collection."""
+    if hasattr(tree, "keys") and set(tree.keys()) == {"params"}:
+        tree = tree["params"]
+    if not hasattr(tree, "items"):
+        return {"all": tree}
+    return dict(tree.items())
+
+
 def make_train_step(
-    model: Alphafold2, mesh: Optional[Mesh] = None, jit: bool = True
+    model: Alphafold2,
+    mesh: Optional[Mesh] = None,
+    jit: bool = True,
+    numerics_mode: str = "off",
 ):
     """Build the jitted distogram-pretraining step.
 
@@ -230,34 +248,56 @@ def make_train_step(
     sharding constraints are active. ``jit=False`` returns the raw traceable
     step for embedding in a larger program (e.g. the in-graph multi-step
     scan in bench.py).
+
+    ``numerics_mode`` widens the metrics dict (observe.numerics):
+
+    - ``"off"`` — exactly the historic metrics (loss, grad_norm, grads_ok,
+      distogram_entropy, skipped).
+    - ``"norms"`` — adds per-parameter-group grad/param/update norms
+      (``grad_norm/<group>`` etc.) beside the existing global ``grad_norm``.
+    - ``"full"`` — norms plus the in-graph activation stats of every
+      ``numerics.tag`` in the model under ``metrics["numerics"]``.
+
+    A tagged and an untagged step are DIFFERENT jitted functions (jit
+    caches by identity); the mode is fixed at build time on purpose.
     """
+    if numerics_mode not in ("off", "norms", "full"):
+        raise ValueError(
+            f"unknown numerics_mode {numerics_mode!r}; "
+            "expected 'off', 'norms' or 'full'"
+        )
 
     def step(state: TrainState, batch: dict, rng: jax.Array):
         ctx = use_mesh(mesh) if mesh is not None else nullcontext()
         with ctx:
             def loss_fn(params):
-                logits = model.apply(
-                    params,
-                    batch["seq"],
-                    batch.get("msa"),
-                    mask=batch["mask"],
-                    msa_mask=batch.get("msa_mask"),
-                    embedds=batch.get("embedds"),  # frozen-PLM feature path
-                    deterministic=False,
-                    rngs={"dropout": rng},
-                )
-                # native-loader batches carry host-precomputed labels
-                # (data/native.py); otherwise bucketize on device
-                labels = batch.get("labels")
-                if labels is None:
-                    labels = get_bucketed_distance_matrix(
-                        batch["coords"], batch["mask"]
+                # collection must live inside the differentiated function:
+                # the tagged activations are forward-pass tracers, valid
+                # only as loss_fn aux outputs (value_and_grad has_aux)
+                with numerics.collect(enabled=numerics_mode == "full") as col:
+                    logits = model.apply(
+                        params,
+                        batch["seq"],
+                        batch.get("msa"),
+                        mask=batch["mask"],
+                        msa_mask=batch.get("msa_mask"),
+                        embedds=batch.get("embedds"),  # frozen-PLM feature path
+                        deterministic=False,
+                        rngs={"dropout": rng},
                     )
-                return distogram_cross_entropy(logits, labels), logits
+                    # native-loader batches carry host-precomputed labels
+                    # (data/native.py); otherwise bucketize on device
+                    labels = batch.get("labels")
+                    if labels is None:
+                        labels = get_bucketed_distance_matrix(
+                            batch["coords"], batch["mask"]
+                        )
+                    loss = distogram_cross_entropy(logits, labels)
+                return loss, (logits, col.stats())
 
-            (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params
-            )
+            ((loss, (logits, act_stats)), grads) = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
             # failure detection: skip the update on non-finite gradients
             grads_ok = jnp.all(
                 jnp.asarray(
@@ -276,6 +316,7 @@ def make_train_step(
                 "loss": loss,
                 "grad_norm": gnorm,
                 "grads_ok": grads_ok,
+                "skipped": new_state.skipped,
                 "distogram_entropy": -jnp.mean(
                     jnp.sum(
                         jax.nn.softmax(logits, -1) * jax.nn.log_softmax(logits, -1),
@@ -283,6 +324,26 @@ def make_train_step(
                     )
                 ),
             }
+            if numerics_mode in ("norms", "full"):
+                # per-parameter-group norm trajectories: which part of the
+                # model is drifting/spiking shows up long before the global
+                # grad_norm moves
+                groups_g = _param_groups(grads)
+                groups_new = _param_groups(new_state.params)
+                groups_old = _param_groups(state.params)
+                for k in groups_g:
+                    metrics[f"grad_norm/{k}"] = optax.global_norm(groups_g[k])
+                    metrics[f"param_norm/{k}"] = optax.global_norm(
+                        groups_new[k]
+                    )
+                    metrics[f"update_norm/{k}"] = optax.global_norm(
+                        jax.tree.map(
+                            lambda a, b: a - b, groups_new[k], groups_old[k]
+                        )
+                    )
+                metrics["param_norm"] = optax.global_norm(new_state.params)
+            if numerics_mode == "full":
+                metrics["numerics"] = act_stats
             return new_state, metrics
 
     if not jit:
@@ -298,6 +359,63 @@ def make_train_step(
         out_shardings=(repl, repl),
         donate_argnums=0,
     )
+
+
+def make_triage_step(model: Alphafold2, mesh: Optional[Mesh] = None):
+    """Fully-tagged diagnostic step for NaN triage.
+
+    Returns triage(params, batch, rng) -> stats, where stats maps every
+    tagged tensor — embeddings, per-trunk-layer pair/MSA streams, distogram
+    logits, the loss — to its ``numerics.tensor_stats``, followed by
+    per-parameter-group gradient stats (``grad/<group>``). Insertion order
+    is topological (forward order, then gradients), so
+    ``numerics.first_nonfinite(stats)`` names the first tensor that went
+    bad. No state update, no donation: the train loop reruns the exact
+    (params, batch, rng) of a skipped step through this after the fast
+    step's non-finite-grad skip fires.
+    """
+
+    def triage(params, batch: dict, rng: jax.Array):
+        ctx = use_mesh(mesh) if mesh is not None else nullcontext()
+        with ctx:
+            def loss_fn(p):
+                with numerics.collect() as col:
+                    logits = model.apply(
+                        p,
+                        batch["seq"],
+                        batch.get("msa"),
+                        mask=batch["mask"],
+                        msa_mask=batch.get("msa_mask"),
+                        embedds=batch.get("embedds"),
+                        deterministic=False,
+                        rngs={"dropout": rng},  # the skipped step's exact rng
+                    )
+                    labels = batch.get("labels")
+                    if labels is None:
+                        labels = get_bucketed_distance_matrix(
+                            batch["coords"], batch["mask"]
+                        )
+                    loss = distogram_cross_entropy(logits, labels)
+                return loss, col.stats()
+
+            (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params
+            )
+            # continue the topological index past the activation tags: the
+            # loss follows the forward pass, gradients follow the loss
+            stats = dict(stats)
+            order = len(stats)
+            stats["loss"] = {
+                "index": order, **numerics.tensor_stats(loss)
+            }
+            for name, sub in _param_groups(grads).items():
+                order += 1
+                stats[f"grad/{name}"] = {
+                    "index": order, **numerics.tree_stats(sub)
+                }
+            return stats
+
+    return jax.jit(triage)
 
 
 def device_prefetch(data_iter, mesh: Optional[Mesh] = None, size: int = 2):
@@ -341,10 +459,14 @@ def device_put_batch(batch: dict, mesh: Optional[Mesh] = None) -> dict:
 
 def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=()):
     """Distogram pretraining driver (the runnable train_pre.py equivalent)."""
+    import os
+    import sys
     import time
 
     from alphafold2_tpu.data.pipeline import make_dataset
     from alphafold2_tpu.observe import MetricsLogger, Profiler, Tracer
+    from alphafold2_tpu.observe import flops as flops_mod
+    from alphafold2_tpu.observe.metrics import flatten_metrics
     from alphafold2_tpu.train.checkpoint import CheckpointManager
 
     num_steps = num_steps or cfg.train.num_steps
@@ -388,7 +510,26 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
     # init at tiny slices of the sample: identical params, none of the
     # full-size init compile (see tiny_init_state)
     state = tiny_init_state(cfg, model, sample)
-    step_fn = make_train_step(model, mesh)
+    # numerics telemetry mode (observe.numerics): "off" | "triage" (fast
+    # step widened with per-parameter-group norms; a fully-tagged rerun
+    # fires only when the non-finite-grad skip does) | "full" (every step
+    # carries tagged activation stats). AF2TPU_NUMERICS overrides the
+    # config for one run.
+    numerics_mode = (
+        os.environ.get("AF2TPU_NUMERICS") or cfg.train.numerics or "off"
+    ).lower()
+    if numerics_mode not in ("off", "triage", "full"):
+        raise ValueError(
+            f"unknown train.numerics {numerics_mode!r}; "
+            "expected 'off', 'triage' or 'full'"
+        )
+    step_fn = make_train_step(
+        model,
+        mesh,
+        numerics_mode={"off": "off", "triage": "norms", "full": "full"}[
+            numerics_mode
+        ],
+    )
 
     ckpt = (
         CheckpointManager(cfg.train.checkpoint_dir, keep=cfg.train.keep_checkpoints)
@@ -445,19 +586,100 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
 
     prefetched = device_prefetch(chain([sample], data_iter), mesh)
     batch = next(prefetched)
+
+    # AOT-compile the step on the single-mesh path: compile time becomes an
+    # explicit metric instead of polluting the first step's rate, and the
+    # compiled executable's XLA cost analysis gives flops/bytes for MFU
+    # accounting (observe.flops — the same parser bench and serve use).
+    # The mesh/multi-host path keeps implicit jit compilation: AOT-compiled
+    # calls are strict about input shardings the loop does not guarantee.
+    step_call = step_fn
+    step_flops = None
+    if mesh is None and jax.process_count() == 1:
+        try:
+            t_c = time.perf_counter()
+            with tracer.span("train.compile"):
+                compiled = step_fn.lower(state, batch, rng).compile()
+            compile_s = time.perf_counter() - t_c
+            costs = flops_mod.executable_costs(compiled)
+            step_flops = costs["flops"]
+            step_call = compiled
+            logger.log(start_step, {
+                "compile_s": round(compile_s, 3),
+                **({"step_flops": step_flops} if step_flops else {}),
+                **({"step_bytes_accessed": costs["bytes_accessed"]}
+                   if costs["bytes_accessed"] else {}),
+            })
+        except Exception as e:  # AOT is an optimization; never block training
+            print(
+                f"train-step AOT compile unavailable ({type(e).__name__}: "
+                f"{e}); falling back to jit", file=sys.stderr,
+            )
+
+    # NaN triage (numerics_mode "triage"/"full"): when a step's non-finite-
+    # grad skip fired, rerun it fully tagged and report the first bad
+    # tensor in topological order. The check runs one iteration LATE (top
+    # of the next loop pass): the skip left params untouched, so the exact
+    # (params, batch, rng) triple is still live, and the host only blocks
+    # on a step that has had a full iteration to complete.
+    triage_fn = None
+    pending = None  # (grads_ok, batch, rng, step index) of the last step
+
+    def run_triage(ok, t_batch, t_rng, t_step):
+        nonlocal triage_fn
+        if bool(ok):
+            return
+        if triage_fn is None:
+            triage_fn = make_triage_step(model, mesh)
+        with tracer.span("train.nan_triage", step=t_step):
+            stats = triage_fn(state.params, t_batch, t_rng)
+        report = numerics.triage_report(stats, step=t_step)
+        logger.log(t_step, {
+            "event": "nan_triage",
+            "first_nonfinite": report["first_nonfinite"],
+            "nonfinite": report["nonfinite"],
+            **numerics.flatten_stats(stats),
+        })
+        tracer.instant(
+            "numerics.nan_triage", step=t_step,
+            first_nonfinite=report["first_nonfinite"],
+        )
+
     t0 = time.perf_counter()
+    last_logged = None
     for i in range(start_step, num_steps):
+        if pending is not None:
+            run_triage(*pending)
+            pending = None
         profiler.maybe_start(i)
         rng, step_rng = jax.random.split(rng)
         with tracer.span("train.step", step=i):
-            state, metrics = step_fn(state, batch, step_rng)
+            state, metrics = step_call(state, batch, step_rng)
         profiler.maybe_stop(i)
-        if (i + 1) % cfg.train.log_every == 0 or i == 0:
-            m = {k: float(v) for k, v in metrics.items()}
-            m["steps_per_sec"] = (
-                cfg.train.log_every / (time.perf_counter() - t0) if i else 0.0
-            )
-            t0 = time.perf_counter()
+        if numerics_mode in ("triage", "full"):
+            pending = (metrics["grads_ok"], batch, step_rng, i)
+        if (i + 1) % cfg.train.log_every == 0 or i == start_step:
+            m = flatten_metrics(metrics)
+            now = time.perf_counter()
+            if last_logged is None:
+                # the session's first step is dispatch- (or, without AOT,
+                # compile-)dominated: record its wall time as its own
+                # metric instead of the old steps_per_sec=0.0 placeholder
+                m["first_step_s"] = round(now - t0, 4)
+            else:
+                m["steps_per_sec"] = (i - last_logged) / max(now - t0, 1e-9)
+                if step_flops:
+                    m["model_flops_per_s"] = step_flops * m["steps_per_sec"]
+                    mfu = flops_mod.mfu(step_flops, 1.0 / m["steps_per_sec"])
+                    if mfu is not None:
+                        m["mfu"] = round(mfu, 4)
+            if numerics_mode == "full" and isinstance(
+                metrics.get("numerics"), dict
+            ):
+                # same numerics/<name> vocabulary in the Perfetto trace
+                numerics.counters_to_tracer(metrics["numerics"], tracer)
+            last_logged = i
+            t0 = now
             logger.log(i, m)
         for cb in callbacks:
             cb(i, state, metrics)
@@ -472,6 +694,8 @@ def train(cfg: Config, num_steps: Optional[int] = None, dataset=None, callbacks=
             break
         with tracer.span("train.next_batch", step=i + 1):
             batch = next(prefetched)
+    if pending is not None:  # a skip on the session's final step
+        run_triage(*pending)
     if prev_handler is not None:
         signal.signal(signal.SIGTERM, prev_handler)
     if ckpt is not None:

@@ -121,6 +121,15 @@ from alphafold2_tpu.observe import (
     Tracer,
 )
 
+# the tree's single cost_analysis()/MFU implementation (observe.flops):
+# bench, the serve engine, the train loop and bisect_perf all share it
+from alphafold2_tpu.observe.flops import (
+    PEAK_FLOPS as _PEAK_FLOPS,
+    SANITY_FLOPS_CEILING as _SANITY_FLOPS_CEILING,
+    estimate_mfu as _estimate_mfu,
+    step_flops as _step_flops,
+)
+
 
 def _tracer() -> Tracer:
     """Span tracer for this bench invocation: Chrome trace-event JSONL at
@@ -365,6 +374,8 @@ def main(overrides: dict | None = None, emit: bool = True,
         # methodology mismatch) — vs_baseline 1.0 then means "not compared",
         # not "at parity"; re-record bench_baseline.json to re-arm
         "vs_baseline_valid": compared,
+        # regression-gate comparisons (observe.regress) are device-keyed
+        "device": jax.devices()[0].device_kind,
     }
     if mfu is not None:
         record["mfu"] = round(mfu, 4)
@@ -375,6 +386,10 @@ def main(overrides: dict | None = None, emit: bool = True,
     # round-1 44.9M pairs/s record was committed unguarded and had to be
     # withdrawn by hand.
     flops = _step_flops(compiled)
+    if flops:
+        # the INGRAPH-chained program's flop count (cost analysis covers
+        # the whole lax.scan, not one step)
+        record["program_flops"] = flops
     achieved = (flops / (dt * INGRAPH)) if flops else None
     if (mfu is not None and mfu > 1.0) or (
         mfu is None and achieved is not None
@@ -549,9 +564,11 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
             _CLOCK["probe"] = _clock_probe()
 
     with _bench_stage(tracer, "serve:timed_run"):
+        flops_before = engine.executed_flops
         t0 = time.perf_counter()
         results = engine.predict_many(reqs)
         wall = time.perf_counter() - t0
+        executed_flops = engine.executed_flops - flops_before
     _PHASE["name"] = "serve:record"
 
     total_residues = int(sum(len(r.seq) for r in reqs))
@@ -587,6 +604,14 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
         "compile_records": engine.compile_records,
         "device": jax.devices()[0].device_kind,
     }
+    if executed_flops:
+        # dispatched model flops over the timed stream (observe.flops)
+        record["flops_total"] = executed_flops
+        from alphafold2_tpu.observe.flops import mfu as _mfu
+
+        serve_mfu = _mfu(executed_flops, wall)
+        if serve_mfu is not None:
+            record["mfu"] = round(serve_mfu, 4)
     spans = tracer.span_totals()
     if spans:
         record["spans"] = spans
@@ -658,54 +683,6 @@ def bench_mode(argv=None) -> str:
         if a.startswith("--mode="):
             return a.split("=", 1)[1]
     return os.environ.get("AF2TPU_BENCH_MODE", "train")
-
-
-# published peak dense bf16 FLOPs/s per chip (v5e's oft-quoted 394 is int8)
-_PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v6e": 918e12,
-}
-
-
-# no production chip sustains 2 PFLOP/s dense bf16 today (v6e peaks at
-# 918 TF); a measurement implying more is a broken clock on ANY device,
-# known or not — the unknown-device fallback for the implausibility guard
-_SANITY_FLOPS_CEILING = 2e15
-
-
-def _step_flops(compiled):
-    """The compiled step's own FLOP count from XLA cost analysis; None when
-    the backend exposes none."""
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, list):  # older jax returns one dict per device
-            cost = cost[0]
-        flops = float(cost.get("flops", 0.0))
-        return flops if flops > 0 else None
-    except Exception:
-        return None  # cost analysis is best-effort; never break the bench
-
-
-def _estimate_mfu(compiled, step_seconds):
-    """Model FLOPs utilization from the compiled step's own cost analysis;
-    None when the backend exposes no flops count or the chip is unknown."""
-    try:
-        flops = _step_flops(compiled)
-        if flops is None:
-            return None
-        kind = jax.devices()[0].device_kind
-        peak = next(
-            (v for k, v in _PEAK_FLOPS.items() if k.lower() in kind.lower()),
-            None,
-        )
-        if peak is None:
-            return None
-        return flops / step_seconds / peak
-    except Exception:
-        return None
 
 
 def _failure_record(msg: str) -> dict:

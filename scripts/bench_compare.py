@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Perf regression gate: compare a bench/serve record against a baseline.
+
+    python scripts/bench_compare.py CURRENT [--baseline PATH]
+        [--threshold metric=tol | metric=direction:tol ...]
+
+``CURRENT`` is a JSON record as emitted by ``bench.py`` (either mode) —
+a file path, or ``-`` to read the record from stdin (so the bench can pipe
+straight in). ``--baseline`` defaults to the committed baseline for the
+record's mode: ``bench_serve_baseline.json`` for serve records,
+``bench_baseline.json`` otherwise.
+
+Prints ONE JSON line: ``{"verdict": "pass"|"regress"|"no-data", ...}`` with
+per-metric comparisons (ratio vs threshold) or a no-data reason. The
+comparison logic — record validity, device/metric/methodology keying,
+thresholds — lives in ``alphafold2_tpu.observe.regress``.
+
+Exit codes: 0 = pass or no-data (an invalid/incomparable record is a
+diagnosis, not a regression), 1 = regression beyond threshold (fails the CI
+step), 2 = unreadable/unparseable input. Pure host-side: no jax import.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from alphafold2_tpu.observe.regress import compare, parse_threshold_overrides
+
+
+def _load_record(path: str) -> dict:
+    if path == "-":
+        text = sys.stdin.read()
+    else:
+        with open(path) as f:
+            text = f.read()
+    # tolerate surrounding noise lines (the bench's contract is one JSON
+    # line on stdout, but operators paste logs): take the first line that
+    # parses as a JSON object
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        raise
+
+
+def default_baseline_path(record: dict) -> str:
+    name = (
+        "bench_serve_baseline.json"
+        if record.get("mode") == "serve"
+        else "bench_baseline.json"
+    )
+    return os.path.join(REPO, name)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0], prog="bench_compare.py"
+    )
+    ap.add_argument("current", help="current record JSON path, or - for stdin")
+    ap.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline record path (default: the committed baseline for the "
+        "record's mode)",
+    )
+    ap.add_argument(
+        "--threshold",
+        action="append",
+        default=[],
+        metavar="METRIC=TOL",
+        help="override a gate threshold, e.g. value=0.2 or p95_ms=lower:0.8",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        current = _load_record(args.current)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"ERROR reading current record {args.current!r}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    if not isinstance(current, dict):
+        print(f"ERROR: current record is not a JSON object: {args.current!r}",
+              file=sys.stderr)
+        return 2
+
+    try:
+        thresholds = parse_threshold_overrides(args.threshold)
+    except ValueError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or default_baseline_path(current)
+    baseline = None
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as f:
+                baseline = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"ERROR reading baseline {baseline_path!r}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+
+    verdict = compare(current, baseline, thresholds)
+    verdict["baseline_path"] = baseline_path
+    print(json.dumps(verdict))
+    if verdict["verdict"] == "regress":
+        print(
+            "REGRESSION: "
+            + ", ".join(
+                f"{c['name']} {c['current']:g} vs baseline "
+                f"{c['baseline']:g} (ratio {c['ratio']}, "
+                f"{c['direction']} better, tol {c['tolerance']})"
+                for c in verdict["comparisons"]
+                if not c["ok"]
+            ),
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

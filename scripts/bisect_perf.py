@@ -19,6 +19,7 @@ alphafold2_tpu.setup_platform()
 import jax
 import jax.numpy as jnp
 
+from alphafold2_tpu.observe.flops import step_flops
 from alphafold2_tpu.ops.attention import Attention, AxialAttention, FeedForward
 
 CROP = int(os.environ.get("AF2TPU_BENCH_CROP", 256))
@@ -37,10 +38,7 @@ def timed(name, module, *args, **kwargs):
 
     step = jax.jit(jax.value_and_grad(loss))
     compiled = step.lower(params).compile()
-    cost = compiled.cost_analysis()
-    if isinstance(cost, list):
-        cost = cost[0]
-    flops = float(cost.get("flops", 0.0))
+    flops = step_flops(compiled) or 0.0  # observe.flops: the one parser
 
     compiled(params)[0].block_until_ready()
     t0 = time.perf_counter()

@@ -87,6 +87,73 @@ def report_trace(path: str) -> int:
     return 0
 
 
+def _fin(values):
+    """Finite subset (NaN-skipping min/max/mean must not be poisoned by the
+    very anomalies the report exists to surface)."""
+    return [v for v in values if isinstance(v, (int, float)) and v == v]
+
+
+def report_train(records: list) -> None:
+    """Training-run section of a metrics.jsonl: loss/grad-norm trajectory,
+    skipped-step accounting, throughput, numerics anomalies and NaN-triage
+    reports (train/loop.py's numerics telemetry)."""
+    steps = [r for r in records if "loss" in r]
+    if not steps:
+        return
+    print(f"-- train ({len(steps)} step records, steps "
+          f"{steps[0].get('step')}..{steps[-1].get('step')}) --")
+    losses = _fin([r["loss"] for r in steps])
+    if losses:
+        print(f"  loss:      {losses[0]:.4g} -> {losses[-1]:.4g}  "
+              f"(min {min(losses):.4g})")
+    gnorms = _fin([r.get("grad_norm") for r in steps])
+    if gnorms:
+        print(f"  grad_norm: first {gnorms[0]:.4g}  last {gnorms[-1]:.4g}  "
+              f"min {min(gnorms):.4g}  max {max(gnorms):.4g}")
+    groups = sorted({
+        k.split("/", 1)[1] for r in steps for k in r
+        if k.startswith("grad_norm/")
+    })
+    if groups:
+        print(f"  per-group norms: {', '.join(groups)}")
+    skipped = max((r.get("skipped", 0) for r in steps), default=0)
+    not_ok = sum(1 for r in steps if r.get("grads_ok") in (0, 0.0, False))
+    print(f"  skipped steps: {int(skipped)} total "
+          f"({not_ok} of the logged steps had non-finite grads)")
+    first = next((r["first_step_s"] for r in steps if "first_step_s" in r),
+                 None)
+    if first is not None:
+        print(f"  first step: {_fmt_s(first)}")
+    compile_s = next(
+        (r["compile_s"] for r in records if "compile_s" in r), None
+    )
+    if compile_s is not None:
+        print(f"  step compile: {_fmt_s(compile_s)}")
+    rates = _fin([r.get("steps_per_sec") for r in steps])
+    if rates:
+        tail = f"  (mfu {steps[-1]['mfu']:.2%})" if "mfu" in steps[-1] else ""
+        print(f"  steps/sec: last {rates[-1]:.4g}  max {max(rates):.4g}"
+              + tail)
+
+    # numerics anomalies: any logged tensor stat with NaN/Inf entries
+    anomalies = sorted({
+        k[len("numerics/"):k.rfind("/")]
+        for r in records
+        for k, v in r.items()
+        if k.startswith("numerics/")
+        and (k.endswith("/nan_count") or k.endswith("/inf_count"))
+        and isinstance(v, (int, float)) and v > 0
+    })
+    if anomalies:
+        print(f"  numerics anomalies (tensors with NaN/Inf): "
+              f"{', '.join(anomalies)}")
+    triages = [r for r in records if r.get("event") == "nan_triage"]
+    for t in triages:
+        print(f"  nan_triage @ step {t.get('step')}: first non-finite = "
+              f"{t.get('first_nonfinite')} "
+              f"({len(t.get('nonfinite', []))} tensors non-finite)")
+
+
 def report_metrics(path: str) -> int:
     records = []
     with open(path) as f:
@@ -101,7 +168,12 @@ def report_metrics(path: str) -> int:
             if k not in ("step", "time"):
                 latest[k] = v
     for k in sorted(latest):
-        print(f"  {k} = {latest[k]}")
+        # per-tensor numerics stats are summarized by the train section
+        # below, not dumped key by key
+        if not k.startswith("numerics/"):
+            print(f"  {k} = {latest[k]}")
+
+    report_train(records)
 
     compiles = latest.get("serve.compiles", latest.get("compiles"))
     hits = latest.get("serve.cache_hits", latest.get("cache_hits"))

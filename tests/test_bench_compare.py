@@ -1,0 +1,244 @@
+"""Perf regression gate tests: observe.regress verdicts (pass / regress /
+invalid-record / missing-baseline), the scripts/bench_compare.py CLI,
+the unified observe.flops accounting, and obs_report's train summary."""
+
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+from alphafold2_tpu.observe import regress
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASE = {
+    "metric": "serve residues/sec tiny", "device": "cpu", "mode": "serve",
+    "value": 100.0, "p50_ms": 10.0, "p95_ms": 20.0, "mfu": 0.2,
+}
+
+
+# ------------------------------------------------------------- regress core
+
+
+def test_compare_pass():
+    v = regress.compare({**BASE, "value": 95.0, "p95_ms": 21.0}, BASE)
+    assert v["verdict"] == "pass"
+    assert {"value", "p50_ms", "p95_ms", "mfu"} <= {
+        c["name"] for c in v["comparisons"]
+    }
+    assert v["regressions"] == []
+
+
+def test_compare_regress_value_and_latency():
+    v = regress.compare({**BASE, "value": 50.0}, BASE)
+    assert v["verdict"] == "regress" and v["regressions"] == ["value"]
+    v = regress.compare({**BASE, "p95_ms": 200.0}, BASE)
+    assert v["verdict"] == "regress" and v["regressions"] == ["p95_ms"]
+
+
+def test_compare_invalid_records():
+    err = {"metric": BASE["metric"], "value": 0.0,
+           "error": "deadline 1500s exceeded during phase 'backend_init'",
+           "phase": "backend_init"}
+    v = regress.compare(err, BASE)
+    assert v["verdict"] == "no-data"
+    assert "current record invalid" in v["reason"]
+    for marker in ({"implausible": True}, {"clock_suspect": True},
+                   {"liveness": "dead"}):
+        assert regress.compare({**BASE, **marker}, BASE)["verdict"] == "no-data"
+    # the committed withdrawn train baseline's shape (value null + invalid)
+    withdrawn = {"metric": "m", "value": None, "invalid": "withdrawn: ..."}
+    v = regress.compare({"metric": "m", "value": 5.0}, withdrawn)
+    assert v["verdict"] == "no-data"
+    assert "baseline record invalid" in v["reason"]
+
+
+def test_compare_is_device_and_methodology_keyed():
+    v = regress.compare({**BASE, "device": "TPU v5 lite"}, BASE)
+    assert v["verdict"] == "no-data" and "device" in v["reason"]
+    v = regress.compare({**BASE, "metric": "other"}, BASE)
+    assert v["verdict"] == "no-data" and "metric label" in v["reason"]
+    v = regress.compare({**BASE, "ingraph": 4}, {**BASE, "ingraph": 8})
+    assert v["verdict"] == "no-data" and "ingraph" in v["reason"]
+    assert regress.compare(BASE, None)["verdict"] == "no-data"
+
+
+def test_threshold_overrides():
+    th = regress.parse_threshold_overrides(["value=0.6", "p95_ms=lower:2.0"])
+    assert th["value"] == ("higher", 0.6)
+    assert th["p95_ms"] == ("lower", 2.0)
+    assert regress.compare({**BASE, "value": 50.0}, BASE, th)["verdict"] == "pass"
+    with pytest.raises(ValueError):
+        regress.parse_threshold_overrides(["value"])
+    with pytest.raises(ValueError):
+        regress.parse_threshold_overrides(["value=sideways:0.5"])
+
+
+# ------------------------------------------------------------------ the CLI
+
+
+@pytest.fixture()
+def bench_compare(monkeypatch):
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    sys.modules.pop("bench_compare", None)
+    yield importlib.import_module("bench_compare")
+    sys.modules.pop("bench_compare", None)
+
+
+def _write(tmp_path, name, rec):
+    p = tmp_path / name
+    p.write_text(json.dumps(rec))
+    return str(p)
+
+
+def test_cli_pass_and_regress(bench_compare, tmp_path, capsys):
+    cur = _write(tmp_path, "cur.json", {**BASE, "value": 95.0})
+    base = _write(tmp_path, "base.json", BASE)
+    assert bench_compare.main([cur, "--baseline", base]) == 0
+    assert json.loads(capsys.readouterr().out)["verdict"] == "pass"
+
+    cur = _write(tmp_path, "cur2.json", {**BASE, "value": 10.0})
+    assert bench_compare.main([cur, "--baseline", base]) == 1
+    captured = capsys.readouterr()
+    assert json.loads(captured.out)["verdict"] == "regress"
+    assert "REGRESSION" in captured.err
+
+
+def test_cli_missing_baseline_and_bad_input(bench_compare, tmp_path, capsys):
+    cur = _write(tmp_path, "cur.json", BASE)
+    missing = str(tmp_path / "nope.json")
+    assert bench_compare.main([cur, "--baseline", missing]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["verdict"] == "no-data" and "missing baseline" in out["reason"]
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json at all")
+    assert bench_compare.main([str(bad), "--baseline", missing]) == 2
+
+
+def test_cli_invalid_bench_record_verdict(bench_compare, tmp_path, capsys):
+    # the exact shape the bench watchdog emits (cf. BENCH_r05.json)
+    rec = {"metric": "residue-pairs/sec/chip crop=256 ...", "value": 0.0,
+           "unit": "pairs/sec", "vs_baseline": 0.0,
+           "vs_baseline_valid": False,
+           "error": "deadline 1500s exceeded during phase "
+                    "'first_light:backend_init'",
+           "phase": "first_light:backend_init"}
+    cur = _write(tmp_path, "cur.json", rec)
+    base = _write(tmp_path, "base.json", BASE)
+    assert bench_compare.main([cur, "--baseline", base]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["verdict"] == "no-data" and "invalid" in out["reason"]
+
+
+def test_cli_default_baseline_routing(bench_compare):
+    assert bench_compare.default_baseline_path({"mode": "serve"}).endswith(
+        "bench_serve_baseline.json"
+    )
+    assert bench_compare.default_baseline_path({}).endswith(
+        "bench_baseline.json"
+    )
+
+
+def test_cli_threshold_override(bench_compare, tmp_path, capsys):
+    cur = _write(tmp_path, "cur.json", {**BASE, "value": 50.0})
+    base = _write(tmp_path, "base.json", BASE)
+    assert bench_compare.main(
+        [cur, "--baseline", base, "--threshold", "value=0.6"]
+    ) == 0
+    assert json.loads(capsys.readouterr().out)["verdict"] == "pass"
+
+
+# --------------------------------------------------------- unified flops
+
+
+def test_flops_single_parser_and_mfu():
+    import jax
+    import jax.numpy as jnp
+
+    from alphafold2_tpu.observe import flops
+
+    compiled = jax.jit(lambda x: x @ x).lower(jnp.ones((32, 32))).compile()
+    costs = flops.executable_costs(compiled)
+    assert flops.step_flops(compiled) == costs["flops"]
+    if costs["flops"] is not None:  # CPU cost analysis exposes flops
+        assert costs["flops"] > 0 and costs["bytes_accessed"] > 0
+    # MFU: explicit peak works; unknown device (CPU) yields None
+    assert flops.mfu(1e12, 1.0, peak=2e12) == 0.5
+    assert flops.mfu(None, 1.0, peak=2e12) is None
+    assert flops.mfu(1e12, 0.0, peak=2e12) is None
+    assert flops.device_peak_flops() is None  # CPU is not in the peak table
+    assert flops.estimate_mfu(compiled, 1.0) is None
+
+    # bench.py sources flops/MFU from observe.flops (single parser in tree)
+    import bench
+
+    assert bench._step_flops is flops.step_flops
+    assert bench._estimate_mfu is flops.estimate_mfu
+    assert bench._PEAK_FLOPS is flops.PEAK_FLOPS
+
+
+def test_cost_analysis_list_form_and_failure():
+    from alphafold2_tpu.observe import flops
+
+    class ListCompiled:  # older jax: one dict per device
+        def cost_analysis(self):
+            return [{"flops": 7.0, "bytes accessed": 3.0}]
+
+    class Broken:
+        def cost_analysis(self):
+            raise RuntimeError("no cost analysis on this backend")
+
+    assert flops.step_flops(ListCompiled()) == 7.0
+    assert flops.executable_costs(ListCompiled())["bytes_accessed"] == 3.0
+    assert flops.step_flops(Broken()) is None
+    assert flops.executable_costs(Broken()) == {
+        "flops": None, "bytes_accessed": None
+    }
+
+
+# ------------------------------------------------ obs_report train summary
+
+
+@pytest.fixture()
+def obs_report(monkeypatch):
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    sys.modules.pop("obs_report", None)
+    yield importlib.import_module("obs_report")
+    sys.modules.pop("obs_report", None)
+
+
+def test_obs_report_train_summary(obs_report, tmp_path, capsys):
+    nan = float("nan")
+    recs = [
+        {"step": 0, "time": 1.0, "compile_s": 2.5, "step_flops": 1e9},
+        {"step": 0, "time": 1.0, "loss": 4.0, "grad_norm": 2.0,
+         "grads_ok": 1.0, "skipped": 0.0, "grad_norm/trunk": 1.5,
+         "first_step_s": 0.5},
+        {"step": 1, "time": 2.0, "loss": nan, "grad_norm": nan,
+         "grads_ok": 0.0, "skipped": 1.0, "grad_norm/trunk": nan,
+         "steps_per_sec": 10.0},
+        {"step": 1, "time": 2.0, "event": "nan_triage",
+         "first_nonfinite": "trunk.layer_0.pair",
+         "nonfinite": ["trunk.layer_0.pair"],
+         "numerics/trunk.layer_0.pair/nan_count": 8.0,
+         "numerics/trunk.layer_0.pair/l2": 0.0},
+        {"step": 2, "time": 3.0, "loss": 3.5, "grad_norm": 1.8,
+         "grads_ok": 1.0, "skipped": 1.0, "steps_per_sec": 12.0},
+    ]
+    path = tmp_path / "metrics.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    assert obs_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "-- train (" in out
+    assert "loss:      4 -> 3.5" in out
+    assert "skipped steps: 1 total (1 of the logged steps" in out
+    assert "first step: 500.00ms" in out
+    assert "step compile: 2.500s" in out
+    assert "per-group norms: trunk" in out
+    assert "numerics anomalies" in out and "trunk.layer_0.pair" in out
+    assert "nan_triage @ step 1: first non-finite = trunk.layer_0.pair" in out
+    # the per-tensor numerics keys are summarized, not dumped one by one
+    assert "numerics/trunk.layer_0.pair/nan_count =" not in out

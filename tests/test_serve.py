@@ -229,6 +229,9 @@ def test_engine_histograms_and_compile_records():
     assert shapes == {(8, 3), (16, 3)}
     assert all(c["seconds"] > 0 for c in eng.compile_records)
     assert len(eng.compile_records) == eng.stats()["serve.compiles"]
+    # flops accounting (observe.flops) rides on every build and dispatch
+    assert all(c.get("flops", 0) > 0 for c in eng.compile_records)
+    assert eng.executed_flops > 0
 
 
 def test_engine_traces_request_lifecycle(tmp_path):
