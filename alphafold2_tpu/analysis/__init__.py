@@ -1,0 +1,52 @@
+"""Graph-hygiene static analysis: the lint/trace-time enforcement layer.
+
+Three layers, each catching a class of defect before a chip runs it:
+
+- :mod:`lint` — JAX-specific AST rules over the package source (host syncs,
+  traced-value control flow, wall-clock/RNG under trace, ...), pure stdlib.
+  CLI: ``python scripts/af2_lint.py alphafold2_tpu/``.
+- :mod:`jaxpr_audit` — abstractly traces the model / train-step / serve
+  executables and statically rejects forbidden primitives (f64 converts,
+  host callbacks), giant baked-in constants and broken donation, under
+  strict dtype promotion. Also fronts the Mosaic TPU lowering gate
+  (:mod:`lowering`). CLI: ``python -m alphafold2_tpu.analysis.jaxpr_audit``.
+- :mod:`contracts` — per-function jaxpr fingerprints (op counts by
+  primitive, input treedefs, donation map) diffed against the committed
+  ``graph_contracts.json`` in CI, mirroring how ``observe/regress.py``
+  gates runtime perf. CLI: ``python -m alphafold2_tpu.analysis.contracts``.
+
+Only :mod:`lint` is imported eagerly — it is jax-free so the lint CLI and
+CI job stay fast and backend-less. The trace-based layers import jax and
+load lazily.
+"""
+
+from alphafold2_tpu.analysis import lint
+from alphafold2_tpu.analysis.lint import (
+    Finding,
+    RULES,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "contracts",
+    "jaxpr_audit",
+    "lint",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+    "lowering",
+]
+
+
+def __getattr__(name):
+    # lazy: these import jax (and lowering additionally assumes a scrubbed
+    # env when run as a gate) — keep `import alphafold2_tpu.analysis` cheap
+    if name in ("jaxpr_audit", "contracts", "lowering", "targets"):
+        import importlib
+
+        return importlib.import_module(f"alphafold2_tpu.analysis.{name}")
+    raise AttributeError(name)

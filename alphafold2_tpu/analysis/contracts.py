@@ -1,0 +1,259 @@
+"""Graph contracts (layer 3): jaxpr fingerprints diffed in CI.
+
+``observe/regress.py`` gates *runtime* perf against a committed baseline;
+this module does the same for *graph shape*. Every registered executable
+(:mod:`targets`) gets a fingerprint — primitive op counts, equation count,
+baked-const footprint, flat input signature, input treedef, donation map —
+committed as ``graph_contracts.json``. CI recomputes and diffs: silent
+graph bloat (a remat dropped, an attention path duplicated, a new host
+callback) or a new recompile key (input signature / treedef change) fails
+the build with a readable per-primitive diff instead of surfacing weeks
+later as an unexplained TPU slowdown.
+
+Fingerprints are exact, not thresholded: a jaxpr is deterministic for a
+given jax version, so ANY drift is either intentional (re-baseline with
+``--update``) or a regression. Baselines are keyed by ``jax.__version__``;
+a version mismatch reports ``stale-baseline`` (rc 0, loudly) rather than
+failing on upstream tracing changes the repo does not control.
+
+CLI::
+
+    JAX_PLATFORMS=cpu python -m alphafold2_tpu.analysis.contracts --check
+    JAX_PLATFORMS=cpu python -m alphafold2_tpu.analysis.contracts --update
+
+Exit codes for ``--check``: 0 contracts hold (or stale baseline),
+1 drift, 2 usage error / missing baseline.
+
+Re-baselining policy: ``--update`` after an INTENTIONAL graph change, and
+the diff the check printed belongs in the PR description — the contract
+file exists so graph changes are reviewed, not discovered.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+FORMAT_VERSION = 1
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "graph_contracts.json",
+)
+
+
+# ------------------------------------------------------------ fingerprints
+
+
+def op_counts(closed) -> dict:
+    """Primitive name -> occurrence count, recursing into sub-jaxprs."""
+    from alphafold2_tpu.analysis.jaxpr_audit import iter_eqns
+
+    counts: dict = {}
+    for eqn in iter_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        counts[name] = counts.get(name, 0) + 1
+    return dict(sorted(counts.items()))
+
+
+def fingerprint_target(target) -> dict:
+    import jax
+
+    from alphafold2_tpu.analysis.targets import example_arg_summary
+
+    fn, args = target.build()
+    closed = jax.make_jaxpr(fn)(*args)
+    counts = op_counts(closed)
+    const_bytes = 0
+    for const in closed.consts:
+        try:
+            const_bytes += int(const.nbytes)
+        except Exception:  # extended dtypes (PRNG keys) have no nbytes
+            import numpy as np
+
+            itemsize = getattr(
+                getattr(const, "dtype", None), "itemsize", None
+            )
+            const_bytes += int(
+                np.prod(tuple(getattr(const, "shape", ())))
+            ) * int(itemsize or 4)
+    _, in_treedef = jax.tree.flatten(args)
+    # static pytree fields (TrainState.apply_fn, ...) repr with memory
+    # addresses; scrub them or the treedef string differs every process
+    import re
+
+    treedef_str = re.sub(r"0x[0-9a-f]+", "0x", str(in_treedef))
+    return {
+        "ops": counts,
+        "n_eqns": sum(counts.values()),
+        "n_consts": len(closed.consts),
+        "const_bytes": const_bytes,
+        "n_outputs": len(closed.jaxpr.outvars),
+        "inputs": example_arg_summary(args),
+        "in_treedef": treedef_str,
+        "donation": sorted(target.donate_argnums),
+    }
+
+
+def compute_contracts(targets=None) -> dict:
+    import jax
+
+    from alphafold2_tpu.analysis.targets import default_targets
+
+    targets = targets if targets is not None else default_targets()
+    return {
+        "format": FORMAT_VERSION,
+        "jax_version": jax.__version__,
+        "targets": {t.name: fingerprint_target(t) for t in targets},
+    }
+
+
+# -------------------------------------------------------------------- diff
+
+
+def _diff_ops(name: str, old: dict, new: dict) -> list:
+    lines = []
+    for prim in sorted(set(old) | set(new)):
+        a, b = old.get(prim, 0), new.get(prim, 0)
+        if a != b:
+            lines.append(
+                f"{name}: op count drift: {prim}: {a} -> {b} ({b - a:+d})"
+            )
+    return lines
+
+
+def diff_contracts(baseline: dict, current: dict) -> list:
+    """Readable drift lines between two contract documents (empty = the
+    contracts hold). Input-signature and donation drifts are flagged as
+    recompile-key changes; op drifts as graph-shape changes."""
+    lines: list = []
+    base_t = baseline.get("targets", {})
+    cur_t = current.get("targets", {})
+    for name in sorted(set(base_t) - set(cur_t)):
+        lines.append(f"{name}: target removed (was under contract)")
+    for name in sorted(set(cur_t) - set(base_t)):
+        lines.append(f"{name}: new target (no committed contract)")
+    for name in sorted(set(base_t) & set(cur_t)):
+        old, new = base_t[name], cur_t[name]
+        if old.get("inputs") != new.get("inputs"):
+            lines.append(
+                f"{name}: RECOMPILE KEY: flat input signature changed: "
+                f"{old.get('inputs')} -> {new.get('inputs')}"
+            )
+        if old.get("in_treedef") != new.get("in_treedef"):
+            lines.append(
+                f"{name}: RECOMPILE KEY: input treedef changed "
+                "(argument pytree structure)"
+            )
+        if old.get("donation") != new.get("donation"):
+            lines.append(
+                f"{name}: donation map changed: {old.get('donation')} -> "
+                f"{new.get('donation')}"
+            )
+        lines.extend(_diff_ops(name, old.get("ops", {}), new.get("ops", {})))
+        for field in ("n_consts", "const_bytes", "n_outputs"):
+            if old.get(field) != new.get(field):
+                lines.append(
+                    f"{name}: {field}: {old.get(field)} -> {new.get(field)}"
+                )
+    return lines
+
+
+def check_against(
+    baseline_path: str = DEFAULT_BASELINE, targets=None
+) -> dict:
+    """Structured verdict: ``{"verdict": "pass"|"drift"|"stale-baseline"|
+    "missing-baseline", ...}`` mirroring observe.regress's explicit
+    no-data third state."""
+    import jax
+
+    if not os.path.exists(baseline_path):
+        return {
+            "verdict": "missing-baseline",
+            "baseline": baseline_path,
+            "reason": "no committed graph_contracts.json; run --update",
+        }
+    with open(baseline_path, "r", encoding="utf-8") as fh:
+        baseline = json.load(fh)
+    current = compute_contracts(targets)
+    out = {
+        "baseline": baseline_path,
+        "baseline_jax": baseline.get("jax_version"),
+        "current_jax": jax.__version__,
+    }
+    if baseline.get("jax_version") != jax.__version__:
+        # an upstream tracing change is not a repo regression: report
+        # loudly, do not fail the build, and ask for a re-baseline
+        return {
+            **out,
+            "verdict": "stale-baseline",
+            "reason": (
+                f"baseline traced under jax {baseline.get('jax_version')}, "
+                f"running {jax.__version__}; re-baseline with --update"
+            ),
+        }
+    diffs = diff_contracts(baseline, current)
+    return {
+        **out,
+        "verdict": "drift" if diffs else "pass",
+        "diffs": diffs,
+        "current": current,
+    }
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    mode = parser.add_mutually_exclusive_group(required=True)
+    mode.add_argument(
+        "--check", action="store_true",
+        help="diff current fingerprints against the committed baseline",
+    )
+    mode.add_argument(
+        "--update", action="store_true",
+        help="recompute fingerprints and rewrite the baseline",
+    )
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE)
+    parser.add_argument(
+        "--json", dest="json_path", default=None,
+        help="write the structured verdict/contracts JSON to this path",
+    )
+    args = parser.parse_args(argv)
+
+    if args.update:
+        contracts = compute_contracts()
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            json.dump(contracts, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(
+            f"graph_contracts: baselined {len(contracts['targets'])} "
+            f"target(s) under jax {contracts['jax_version']} -> "
+            f"{args.baseline}"
+        )
+        if args.json_path:
+            with open(args.json_path, "w", encoding="utf-8") as fh:
+                json.dump(contracts, fh, indent=2, sort_keys=True)
+        return 0
+
+    result = check_against(args.baseline)
+    if args.json_path:
+        with open(args.json_path, "w", encoding="utf-8") as fh:
+            json.dump(result, fh, indent=2, sort_keys=True)
+    for line in result.get("diffs", []):
+        print(f"graph-contract DRIFT: {line}")
+    print(f"graph_contracts: verdict={result['verdict']}"
+          + (f" ({result['reason']})" if result.get("reason") else ""))
+    if result["verdict"] == "missing-baseline":
+        return 2
+    return 1 if result["verdict"] == "drift" else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,422 @@
+"""Jaxpr/HLO auditor (layer 2): semantic graph-hygiene enforcement.
+
+The AST linter (:mod:`lint`) catches what is visible in source; this module
+catches what is only visible in the traced graph. It abstractly traces the
+registered executables (:mod:`targets`: model forward, train step, serve
+forward) on the host — no device, no compile — and statically rejects:
+
+- ``AF2A100`` error — the target fails to trace at all (the audit cannot
+  certify a graph it cannot build).
+- ``AF2A101`` error — float64/complex128 anywhere in the graph (any aval or
+  a ``convert_element_type`` to a wide dtype): on TPU an f64 leak is a
+  silent 2x memory + emulation cliff, paid at N^2 scale in the pair stream.
+- ``AF2A102`` error — host-callback primitives in the hot path
+  (``pure_callback``/``io_callback``/``debug_callback``/infeed/outfeed):
+  each one is a device->host round trip per step.
+- ``AF2A103`` error — giant baked-in constants (> threshold bytes closed
+  over into the jaxpr): they bloat every executable and recompile key
+  instead of riding as arguments.
+- ``AF2A104`` warning — broken donation: a ``donate_argnums`` declaration
+  whose buffers can never alias any output (no shape/dtype match), i.e.
+  the donation documents an intent the runtime cannot honor.
+- ``AF2A105`` error — the target only traces under default dtype
+  promotion: under ``jax.numpy_dtype_promotion("strict")`` the trace
+  raises, meaning an implicit promotion (usually bool/int drawn into
+  float math) is hiding in the graph.
+
+Rule ``AF2A106`` (Mosaic TPU lowering failure) folds the Pallas lowering
+gate (:mod:`alphafold2_tpu.analysis.lowering`, formerly the whole of
+``scripts/check_tpu_lowering.py``) into the same findings stream: ``--rules
+jaxpr,lowering`` is the single pre-hardware gate entry point.
+
+CLI::
+
+    JAX_PLATFORMS=cpu python -m alphafold2_tpu.analysis.jaxpr_audit \
+        [--targets model_fwd,train_step] [--rules jaxpr,lowering] \
+        [--const-threshold BYTES] [--json out.json]
+
+Exit codes: 0 clean, 1 findings, 2 usage error. Targets may waive specific
+rules (with a recorded reason) via ``TraceTarget.allow``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterable, Optional
+
+AUDIT_RULES = {
+    "AF2A100": ("error", "target fails to trace"),
+    "AF2A101": ("error", "float64/complex128 in graph"),
+    "AF2A102": ("error", "host callback primitive in hot path"),
+    "AF2A103": ("error", "giant baked-in constant"),
+    "AF2A104": ("warning", "declared donation can never alias"),
+    "AF2A105": ("error", "strict dtype promotion violation"),
+    "AF2A106": ("error", "Mosaic TPU lowering failure"),
+}
+
+FORBIDDEN_PRIMITIVES = {
+    "pure_callback",
+    "io_callback",
+    "debug_callback",
+    "callback",
+    "host_callback",
+    "outside_call",
+    "infeed",
+    "outfeed",
+}
+
+WIDE_DTYPES = ("float64", "complex128")
+
+DEFAULT_CONST_THRESHOLD = 1 << 20  # 1 MiB baked into a graph is a bug
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditFinding:
+    rule: str
+    severity: str
+    target: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.target}: {self.rule} [{self.severity}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _finding(rule: str, target: str, message: str) -> AuditFinding:
+    return AuditFinding(rule, AUDIT_RULES[rule][0], target, message)
+
+
+# --------------------------------------------------------------- traversal
+
+
+def _sub_jaxprs(params: dict):
+    from jax.extend import core as jex_core
+
+    def walk(value):
+        if isinstance(value, jex_core.ClosedJaxpr):
+            yield value.jaxpr
+        elif isinstance(value, jex_core.Jaxpr):
+            yield value
+        elif isinstance(value, (list, tuple)):
+            for v in value:
+                yield from walk(v)
+
+    for value in params.values():
+        yield from walk(value)
+
+
+def iter_eqns(jaxpr) -> Iterable:
+    """Every equation in ``jaxpr``, recursing into call/control-flow
+    sub-jaxprs (scan bodies, cond branches, pjit calls, remat)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def _aval_dtypes(eqn):
+    for var in list(eqn.invars) + list(eqn.outvars):
+        aval = getattr(var, "aval", None)
+        dtype = getattr(aval, "dtype", None)
+        if dtype is not None:
+            yield str(dtype)
+
+
+# ------------------------------------------------------------- jaxpr rules
+
+
+def audit_closed_jaxpr(
+    closed,
+    target: str = "<jaxpr>",
+    const_threshold: int = DEFAULT_CONST_THRESHOLD,
+) -> list:
+    """Pure jaxpr rules (AF2A101/102/103) over an already-traced graph."""
+    import numpy as np
+
+    findings: list = []
+    wide_hits: dict = {}
+    callback_hits: dict = {}
+    for eqn in iter_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        if name in FORBIDDEN_PRIMITIVES:
+            callback_hits[name] = callback_hits.get(name, 0) + 1
+        if name == "convert_element_type":
+            new = str(eqn.params.get("new_dtype", ""))
+            if new in WIDE_DTYPES:
+                wide_hits[f"convert_element_type->{new}"] = (
+                    wide_hits.get(f"convert_element_type->{new}", 0) + 1
+                )
+        for dtype in _aval_dtypes(eqn):
+            if dtype in WIDE_DTYPES:
+                wide_hits[dtype] = wide_hits.get(dtype, 0) + 1
+    for what, count in sorted(wide_hits.items()):
+        findings.append(_finding(
+            "AF2A101", target,
+            f"{what} appears {count}x in the graph; the TPU path is "
+            "f32/bf16-only — find the implicit widening",
+        ))
+    for prim, count in sorted(callback_hits.items()):
+        findings.append(_finding(
+            "AF2A102", target,
+            f"host callback primitive {prim!r} appears {count}x: each is a "
+            "device->host round trip per executed step",
+        ))
+    for i, const in enumerate(closed.consts):
+        try:
+            nbytes = int(const.nbytes)
+        except Exception:  # extended dtypes (PRNG keys) have no nbytes
+            shape = tuple(getattr(const, "shape", ()))
+            itemsize = getattr(
+                getattr(const, "dtype", None), "itemsize", None
+            )
+            nbytes = int(np.prod(shape)) * int(itemsize or 4)
+        if nbytes > const_threshold:
+            shape = tuple(getattr(const, "shape", ()))
+            findings.append(_finding(
+                "AF2A103", target,
+                f"baked-in constant #{i} is {nbytes} bytes (shape {shape}) "
+                f"> threshold {const_threshold}; pass it as an argument so "
+                "it is not serialized into every executable",
+            ))
+    return findings
+
+
+def audit_donation(fn, args, donate_argnums, target: str) -> list:
+    """AF2A104: donated input leaves with no shape/dtype-matching output."""
+    import collections
+
+    import jax
+
+    out_shape = jax.eval_shape(fn, *args)
+    out_sig = collections.Counter(
+        (tuple(leaf.shape), str(leaf.dtype))
+        for leaf in jax.tree.leaves(out_shape)
+        if hasattr(leaf, "shape")
+    )
+    findings = []
+    for argnum in donate_argnums:
+        donated = jax.tree.leaves(args[argnum])
+        dead = []
+        for leaf in donated:
+            if not hasattr(leaf, "shape"):
+                continue
+            sig = (tuple(leaf.shape), str(leaf.dtype))
+            if out_sig.get(sig, 0) > 0:
+                out_sig[sig] -= 1
+            else:
+                dead.append(f"{leaf.dtype}{list(leaf.shape)}")
+        if dead and len(dead) == len(donated):
+            findings.append(_finding(
+                "AF2A104", target,
+                f"donated argument {argnum} ({len(dead)} buffer(s): "
+                f"{', '.join(sorted(set(dead))[:4])}...) matches no output "
+                "shape/dtype — XLA cannot alias any of it; drop or justify "
+                "the donation",
+            ))
+    return findings
+
+
+# ----------------------------------------------------------------- targets
+
+
+def _is_promotion_error(e: BaseException) -> bool:
+    text = f"{type(e).__name__}: {e}"
+    return "promot" in text.lower()
+
+
+def audit_target(
+    target, const_threshold: int = DEFAULT_CONST_THRESHOLD
+) -> list:
+    """Trace one :class:`~alphafold2_tpu.analysis.targets.TraceTarget` and
+    run every rule, honoring its ``allow`` waivers."""
+    import jax
+
+    name = target.name
+    try:
+        fn, args = target.build()
+    except Exception as e:  # build failures are un-audit-able targets
+        return [_finding(
+            "AF2A100", name,
+            f"target build failed: {type(e).__name__}: {str(e)[:300]}",
+        )]
+
+    findings: list = []
+    # strict promotion first: the same trace, one config flag stricter
+    with jax.numpy_dtype_promotion("strict"):
+        try:
+            closed = jax.make_jaxpr(fn)(*args)
+            strict_ok = True
+        except Exception as e:
+            strict_ok = False
+            if _is_promotion_error(e):
+                findings.append(_finding(
+                    "AF2A105", name,
+                    "trace raises under strict dtype promotion: "
+                    f"{str(e).splitlines()[0][:300]}",
+                ))
+            else:
+                findings.append(_finding(
+                    "AF2A100", name,
+                    f"trace failed (strict promotion): {type(e).__name__}: "
+                    f"{str(e)[:300]}",
+                ))
+    if not strict_ok:
+        try:
+            closed = jax.make_jaxpr(fn)(*args)
+        except Exception as e:
+            return [f for f in findings if f.rule != "AF2A105"] + [_finding(
+                "AF2A100", name,
+                f"trace failed: {type(e).__name__}: {str(e)[:300]}",
+            )]
+
+    findings.extend(audit_closed_jaxpr(closed, name, const_threshold))
+    if target.donate_argnums:
+        findings.extend(
+            audit_donation(fn, args, target.donate_argnums, name)
+        )
+    return [f for f in findings if f.rule not in target.allow]
+
+
+def audit(
+    targets=None, const_threshold: int = DEFAULT_CONST_THRESHOLD
+) -> list:
+    from alphafold2_tpu.analysis.targets import default_targets
+
+    targets = targets if targets is not None else default_targets()
+    findings: list = []
+    for t in targets:
+        findings.extend(audit_target(t, const_threshold))
+    return findings
+
+
+# ------------------------------------------------------- lowering rule set
+
+
+def lowering_findings(case_names=None) -> list:
+    """Run the Mosaic TPU lowering gate (analysis.lowering) in a scrubbed
+    subprocess and convert failed cases into AF2A106 findings.
+
+    This is the fold-in of ``scripts/check_tpu_lowering.py``: same cases,
+    same negative control, one findings stream."""
+    import subprocess
+    import sys
+
+    from alphafold2_tpu.preflight import scrub_axon_env
+
+    env = scrub_axon_env()
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["AF2TPU_LOWERING_GATE_SCRUBBED"] = "1"
+    cmd = [sys.executable, "-m", "alphafold2_tpu.analysis.lowering"]
+    cmd += list(case_names or ())
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, env=env, timeout=1800
+    )
+    findings = []
+    summary = None
+    for line in proc.stdout.splitlines():
+        if not line.startswith("{"):
+            continue
+        rec = json.loads(line)
+        if rec.get("gate"):
+            summary = rec
+        elif "case" in rec and not rec.get("ok"):
+            findings.append(_finding(
+                "AF2A106", rec["case"],
+                f"Mosaic lowering failed: {rec.get('error', '?')[:300]}",
+            ))
+    if summary is None:
+        findings.append(_finding(
+            "AF2A106", "lowering_gate",
+            "gate produced no summary record "
+            f"(rc={proc.returncode}); stderr tail: {proc.stderr[-300:]}",
+        ))
+    elif summary.get("error"):
+        # e.g. a typo'd case name: the gate refuses to certify anything —
+        # that refusal must surface as a finding, not read as green
+        findings.append(_finding(
+            "AF2A106", "lowering_gate", f"gate error: {summary['error']}"
+        ))
+    return findings
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def findings_to_json(findings: list) -> str:
+    return json.dumps(
+        {
+            "tool": "jaxpr_audit",
+            "findings": [f.to_dict() for f in findings],
+            "counts": {
+                "error": sum(1 for f in findings if f.severity == "error"),
+                "warning": sum(
+                    1 for f in findings if f.severity == "warning"
+                ),
+            },
+        },
+        indent=2,
+    )
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from alphafold2_tpu.analysis.targets import default_targets, target_by_name
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--targets", default=None,
+        help="comma-separated target names (default: all registered)",
+    )
+    parser.add_argument(
+        "--rules", default="jaxpr",
+        help="comma-separated rule sets: jaxpr, lowering (default: jaxpr)",
+    )
+    parser.add_argument(
+        "--const-threshold", type=int, default=DEFAULT_CONST_THRESHOLD
+    )
+    parser.add_argument("--json", dest="json_path", default=None)
+    args = parser.parse_args(argv)
+
+    rule_sets = {s.strip() for s in args.rules.split(",") if s.strip()}
+    unknown = rule_sets - {"jaxpr", "lowering"}
+    if unknown:
+        print(f"unknown rule set(s): {sorted(unknown)}")
+        return 2
+
+    findings: list = []
+    if "jaxpr" in rule_sets:
+        if args.targets:
+            try:
+                targets = [
+                    target_by_name(n.strip())
+                    for n in args.targets.split(",") if n.strip()
+                ]
+            except KeyError as e:
+                print(str(e))
+                return 2
+        else:
+            targets = default_targets()
+        findings.extend(audit(targets, args.const_threshold))
+    if "lowering" in rule_sets:
+        findings.extend(lowering_findings())
+
+    for f in findings:
+        print(f.format())
+    if args.json_path:
+        with open(args.json_path, "w", encoding="utf-8") as fh:
+            fh.write(findings_to_json(findings))
+    print(
+        f"jaxpr_audit: {len(findings)} finding(s) over rule sets "
+        f"{sorted(rule_sets)}"
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

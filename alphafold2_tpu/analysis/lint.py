@@ -1,0 +1,595 @@
+"""JAX graph-hygiene AST linter (layer 1 of the static-analysis subsystem).
+
+The trunk flattens the pairwise map into an N^2 token stream, so a single
+accidental host sync or retrace inside the jitted path is paid at quadratic
+scale on hardware we cannot iterate on interactively. PRs 2-3 built the
+*runtime* half (tracing, numerics, the bench-compare gate); this module is
+the *static* half: purely syntactic rules over the package source that flag
+graph-hygiene bugs at lint time, before a chip ever runs them.
+
+Rules (id, severity):
+
+- ``AF2L001`` error — Python ``if``/``while`` truthiness on a traced
+  function parameter inside a jit context (concretization error at trace
+  time, or worse: silently baked-in branch).
+- ``AF2L002`` error — host sync under trace: ``.item()`` / ``.tolist()`` /
+  ``.block_until_ready()`` / ``np.asarray`` / ``np.array`` /
+  ``jax.device_get`` / builtin ``float``/``int``/``bool`` applied to a
+  traced parameter.
+- ``AF2L003`` error — wall-clock read under trace (``time.time`` /
+  ``perf_counter`` / ``monotonic`` / ``datetime.now``): trace-time constant
+  masquerading as a timestamp.
+- ``AF2L004`` error — non-JAX RNG under trace (``random.*``,
+  ``np.random.*``): trace-time constant masquerading as randomness.
+- ``AF2L005`` warning — mutable default argument (shared across calls).
+- ``AF2L006`` warning — bare ``except:`` (swallows KeyboardInterrupt and
+  the tracer errors the other rules exist to surface).
+- ``AF2L007`` warning — traced parameter of a jitted function used where
+  only a Python value works (``range()``, f-string): needs
+  ``static_argnames``/``static_argnums``.
+- ``AF2L008`` warning — ``print`` under trace (fires at trace time only;
+  use ``jax.debug.print`` or the observe subsystem).
+- ``AF2L009`` warning — host side effect under trace (counter ``.bump`` /
+  histogram ``.observe`` / ``logging``): runs per *trace*, not per step.
+
+A *jit context* is a function that is (a) decorated with ``jax.jit`` /
+``jit`` / ``partial(jax.jit, ...)``, (b) passed to a ``*.jit(...)`` call
+anywhere in the same module (``jax.jit(step, ...)``, ``jax.jit(self._fwd,
+donate_argnums=...)``), or (c) passed as the body of a ``lax`` control-flow
+primitive (``scan``/``while_loop``/``fori_loop``/``cond``/``switch``).
+Functions nested inside a jit context inherit it (closures are traced too).
+Parameters named in ``static_argnames``/``static_argnums`` are exempt.
+
+Suppression: ``# af2: noqa[AF2L001]`` (comma-separated ids) or a blanket
+``# af2: noqa`` on the finding's line. Suppressions should carry a reason
+in the surrounding comment — they are reviewed, not free.
+
+Scope and honesty: this is syntactic analysis. It tracks direct parameter
+references, not dataflow through locals, so it catches the
+reviewable-by-grep class of bug and leaves semantic enforcement to the
+jaxpr auditor (:mod:`alphafold2_tpu.analysis.jaxpr_audit`). Pure stdlib —
+importable (and fast) without jax, so CI lints before installing a backend.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable, Optional
+
+SEVERITIES = ("error", "warning")
+
+RULES = {
+    "AF2L001": ("error", "traced-value Python control flow under jit"),
+    "AF2L002": ("error", "host sync under jit"),
+    "AF2L003": ("error", "wall-clock read under jit"),
+    "AF2L004": ("error", "non-JAX RNG under jit"),
+    "AF2L005": ("warning", "mutable default argument"),
+    "AF2L006": ("warning", "bare except"),
+    "AF2L007": ("warning", "traced param needs static_argnames"),
+    "AF2L008": ("warning", "print under jit"),
+    "AF2L009": ("warning", "host side effect under jit"),
+}
+
+_NOQA_RE = re.compile(r"#\s*af2:\s*noqa(?:\[([A-Za-z0-9_,\s]+)\])?")
+
+# lax control-flow combinators whose function arguments are traced bodies
+_LAX_BODY_CALLS = {
+    "scan", "while_loop", "fori_loop", "cond", "switch", "associative_scan",
+}
+_WALLCLOCK_ATTRS = {
+    "time", "perf_counter", "monotonic", "process_time", "perf_counter_ns",
+    "monotonic_ns", "time_ns", "now", "utcnow",
+}
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_SIDE_EFFECT_METHODS = {"bump", "observe", "add_scalar", "write"}
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"[{self.severity}] {self.message}"
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _noqa_lines(source: str) -> dict:
+    """line number -> set of suppressed rule ids (empty set = all rules)."""
+    out: dict = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _NOQA_RE.search(text)
+        if not m:
+            continue
+        ids = m.group(1)
+        out[i] = (
+            {s.strip().upper() for s in ids.split(",") if s.strip()}
+            if ids else set()
+        )
+    return out
+
+
+def _attr_chain(node: ast.AST) -> list:
+    """``jax.lax.scan`` -> ["jax", "lax", "scan"]; [] if not a pure chain."""
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    """Does this expression name a jit transform (``jax.jit``, ``jit``,
+    ``nn.jit``)?"""
+    chain = _attr_chain(node)
+    return bool(chain) and chain[-1] == "jit"
+
+
+def _static_names_from_call(call: ast.Call) -> set:
+    """Parameter names declared static in a jit(...) call's keywords.
+
+    ``static_argnums`` positions cannot be resolved to names here (the
+    function definition may live elsewhere); callers resolve them against
+    the def's positional args when they can.
+    """
+    names: set = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str
+                ):
+                    names.add(node.value)
+    return names
+
+
+def _static_nums_from_call(call: ast.Call) -> set:
+    nums: set = set()
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "static_argnum"):
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(
+                    node.value, int
+                ):
+                    nums.add(node.value)
+    return nums
+
+
+class _JitIndex(ast.NodeVisitor):
+    """Module pass 1: which function names are jitted / lax bodies, and
+    with which static argument declarations."""
+
+    def __init__(self):
+        # name -> {"static_names": set, "static_nums": set}
+        self.jitted: dict = {}
+
+    def _record(self, name: str, static_names: set, static_nums: set):
+        rec = self.jitted.setdefault(
+            name, {"static_names": set(), "static_nums": set()}
+        )
+        rec["static_names"] |= static_names
+        rec["static_nums"] |= static_nums
+
+    def visit_Call(self, node: ast.Call):
+        chain = _attr_chain(node.func)
+        if _is_jit_callable(node.func) and node.args:
+            target = node.args[0]
+            tchain = _attr_chain(target)
+            if tchain:
+                self._record(
+                    tchain[-1],
+                    _static_names_from_call(node),
+                    _static_nums_from_call(node),
+                )
+        elif chain and chain[-1] in _LAX_BODY_CALLS:
+            for arg in node.args:
+                achain = _attr_chain(arg)
+                if achain and len(achain) == 1:
+                    self._record(achain[-1], set(), set())
+        self.generic_visit(node)
+
+
+def _decorator_jit_info(fn: ast.AST) -> Optional[tuple]:
+    """(static_names, static_nums) if the def carries a jit decorator."""
+    for dec in fn.decorator_list:
+        if _is_jit_callable(dec):
+            return set(), set()
+        if isinstance(dec, ast.Call):
+            if _is_jit_callable(dec.func):
+                return _static_names_from_call(dec), _static_nums_from_call(dec)
+            chain = _attr_chain(dec.func)
+            if chain and chain[-1] == "partial" and dec.args and \
+                    _is_jit_callable(dec.args[0]):
+                return _static_names_from_call(dec), _static_nums_from_call(dec)
+    return None
+
+
+def _positional_params(fn: ast.AST) -> list:
+    return [a.arg for a in fn.args.posonlyargs + fn.args.args]
+
+
+def _param_names(fn: ast.AST) -> list:
+    names = _positional_params(fn)
+    names += [a.arg for a in fn.args.kwonlyargs]
+    if fn.args.vararg:
+        names.append(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        names.append(fn.args.kwarg.arg)
+    return names
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.findings: list = []
+        self.noqa = _noqa_lines(source)
+        index = _JitIndex()
+        self.tree = ast.parse(source, filename=path)
+        index.visit(self.tree)
+        self.jit_index = index.jitted
+        # stack of traced-name sets; non-empty means "inside a jit context"
+        self._traced_stack: list = []
+
+    # ------------------------------------------------------------- plumbing
+
+    def run(self) -> list:
+        self.visit(self.tree)
+        return sorted(self.findings, key=lambda f: (f.line, f.col, f.rule))
+
+    def _emit(self, rule: str, node: ast.AST, message: str):
+        line = getattr(node, "lineno", 0)
+        suppressed = self.noqa.get(line)
+        if suppressed is not None and (not suppressed or rule in suppressed):
+            return
+        severity = RULES[rule][0]
+        self.findings.append(
+            Finding(rule, severity, self.path, line,
+                    getattr(node, "col_offset", 0), message)
+        )
+
+    def _in_jit(self) -> bool:
+        return bool(self._traced_stack)
+
+    def _traced(self, name: str) -> bool:
+        return any(name in frame for frame in self._traced_stack)
+
+    def _names_in(self, node: ast.AST) -> set:
+        return {
+            n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+        }
+
+    def _traced_names_in(self, node: ast.AST) -> set:
+        return {n for n in self._names_in(node) if self._traced(n)}
+
+    # ------------------------------------------------------------ functions
+
+    def _function_traced_params(self, fn) -> Optional[set]:
+        """The traced parameter set if ``fn`` opens a jit context here."""
+        info = _decorator_jit_info(fn)
+        if info is None and fn.name in self.jit_index:
+            rec = self.jit_index[fn.name]
+            info = (rec["static_names"], rec["static_nums"])
+        if info is None:
+            if self._in_jit():
+                return set(_param_names(fn)) - {"self", "cls"}
+            return None
+        static_names, static_nums = info
+        positional = _positional_params(fn)
+        skip_self = positional[:1] == ["self"] or positional[:1] == ["cls"]
+        resolved = set(static_names)
+        for i in static_nums:
+            # static_argnums indexes the python signature as jit sees it
+            if 0 <= i < len(positional):
+                resolved.add(positional[i])
+        return set(_param_names(fn)) - resolved - {"self", "cls"}
+
+    def _visit_function(self, fn):
+        self._check_mutable_defaults(fn)
+        traced = self._function_traced_params(fn)
+        if traced is None:
+            self.generic_visit(fn)
+            return
+        self._traced_stack.append(traced)
+        try:
+            self.generic_visit(fn)
+        finally:
+            self._traced_stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_Lambda(self, node):
+        if self._in_jit():
+            self._traced_stack.append(set(_param_names(node)))
+            try:
+                self.generic_visit(node)
+            finally:
+                self._traced_stack.pop()
+        else:
+            self.generic_visit(node)
+
+    # ------------------------------------------------- always-on rules
+
+    def _check_mutable_defaults(self, fn):
+        for default in list(fn.args.defaults) + [
+            d for d in fn.args.kw_defaults if d is not None
+        ]:
+            mutable = isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(default, ast.Call)
+                and _attr_chain(default.func) in (["list"], ["dict"], ["set"])
+            )
+            if mutable:
+                self._emit(
+                    "AF2L005", default,
+                    f"mutable default argument in {fn.name}(): evaluated "
+                    "once and shared across calls; default to None",
+                )
+
+    def visit_ExceptHandler(self, node):
+        if node.type is None:
+            self._emit(
+                "AF2L006", node,
+                "bare except: catches KeyboardInterrupt/SystemExit and "
+                "masks tracer errors; name the exception class",
+            )
+        self.generic_visit(node)
+
+    # ---------------------------------------------------- traced-only rules
+
+    def _truthiness_on_traced(self, test: ast.AST) -> Optional[str]:
+        """Name of a traced param whose runtime truthiness the test needs,
+        or None. ``is (not) None`` / ``in`` checks are pytree-structure
+        tests and exempt."""
+        if isinstance(test, ast.Name) and self._traced(test.id):
+            return test.id
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._truthiness_on_traced(test.operand)
+        if isinstance(test, ast.BoolOp):
+            for v in test.values:
+                hit = self._truthiness_on_traced(v)
+                if hit:
+                    return hit
+            return None
+        if isinstance(test, ast.Compare):
+            if all(
+                isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                for op in test.ops
+            ):
+                return None
+            for side in [test.left] + test.comparators:
+                if isinstance(side, ast.Name) and self._traced(side.id):
+                    return side.id
+        return None
+
+    def _check_branch(self, node, kind: str):
+        if not self._in_jit():
+            return
+        hit = self._truthiness_on_traced(node.test)
+        if hit:
+            self._emit(
+                "AF2L001", node,
+                f"python {kind} on traced parameter {hit!r}: concretizes "
+                "under trace; use lax.cond/lax.select or mark the argument "
+                "static",
+            )
+
+    def visit_If(self, node):
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_branch(node, "while")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        self._check_branch(node, "conditional expression")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        if self._in_jit():
+            hit = self._truthiness_on_traced(node.test)
+            if hit:
+                self._emit(
+                    "AF2L001", node,
+                    f"assert on traced parameter {hit!r} concretizes under "
+                    "trace; use checkify or a mask",
+                )
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node):
+        if self._in_jit():
+            for value in node.values:
+                if isinstance(value, ast.FormattedValue):
+                    for name in self._traced_names_in(value.value):
+                        self._emit(
+                            "AF2L007", node,
+                            f"traced parameter {name!r} formatted into an "
+                            "f-string under trace: stringifies the tracer, "
+                            "not the value; mark it static or use "
+                            "jax.debug.print",
+                        )
+                        break
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        if self._in_jit():
+            self._check_traced_call(node)
+        self.generic_visit(node)
+
+    def _check_traced_call(self, node: ast.Call):
+        chain = _attr_chain(node.func)
+        # AF2L002: host syncs
+        if chain and chain[-1] in _HOST_SYNC_METHODS and len(chain) > 1:
+            self._emit(
+                "AF2L002", node,
+                f".{chain[-1]}() under trace forces a device sync (or "
+                "fails on a tracer); keep values on device",
+            )
+            return
+        if len(chain) >= 2 and chain[0] in _NUMPY_ALIASES and chain[1] in (
+            "asarray", "array"
+        ):
+            self._emit(
+                "AF2L002", node,
+                f"{'.'.join(chain)}() under trace pulls the value to host "
+                "(ConcretizationError on a tracer); use jnp",
+            )
+            return
+        if chain and chain[-1] == "device_get":
+            self._emit(
+                "AF2L002", node,
+                "jax.device_get under trace is a host sync; return the "
+                "value instead",
+            )
+            return
+        if chain in (["float"], ["int"], ["bool"], ["complex"]) and node.args:
+            names = self._traced_names_in(node.args[0])
+            if names:
+                self._emit(
+                    "AF2L002", node,
+                    f"builtin {chain[0]}() on traced parameter "
+                    f"{sorted(names)[0]!r} concretizes under trace; use "
+                    f"jnp/astype",
+                )
+                return
+        # AF2L003: wall clock
+        if (
+            len(chain) >= 2
+            and chain[0] in ("time", "datetime")
+            and chain[-1] in _WALLCLOCK_ATTRS
+        ):
+            self._emit(
+                "AF2L003", node,
+                f"{'.'.join(chain)}() under trace is evaluated once at "
+                "trace time and baked into the graph",
+            )
+            return
+        # AF2L004: non-JAX RNG
+        if chain and chain[0] == "random" and len(chain) >= 2:
+            self._emit(
+                "AF2L004", node,
+                f"stdlib {'.'.join(chain)}() under trace bakes one sample "
+                "into the graph; use jax.random with an explicit key",
+            )
+            return
+        if len(chain) >= 3 and chain[0] in _NUMPY_ALIASES and \
+                chain[1] == "random":
+            self._emit(
+                "AF2L004", node,
+                f"{'.'.join(chain)}() under trace bakes one sample into "
+                "the graph; use jax.random with an explicit key",
+            )
+            return
+        # AF2L007: python-only sinks for traced params
+        if chain == ["range"]:
+            for arg in node.args:
+                names = self._traced_names_in(arg)
+                if names:
+                    self._emit(
+                        "AF2L007", node,
+                        f"range() over traced parameter "
+                        f"{sorted(names)[0]!r}: needs a concrete int — "
+                        "declare it in static_argnames or use lax.fori_loop",
+                    )
+                    break
+            return
+        # AF2L008: print
+        if chain == ["print"]:
+            self._emit(
+                "AF2L008", node,
+                "print under trace fires once per trace, not per step; use "
+                "jax.debug.print or observe",
+            )
+            return
+        # AF2L009: host side effects
+        if chain and len(chain) > 1 and chain[-1] in _SIDE_EFFECT_METHODS:
+            self._emit(
+                "AF2L009", node,
+                f".{chain[-1]}() under trace is a host side effect: it "
+                "runs per trace, never per executed step",
+            )
+            return
+        if chain and chain[0] in ("logging", "logger", "log"):
+            self._emit(
+                "AF2L009", node,
+                f"{'.'.join(chain)}() under trace logs at trace time only",
+            )
+
+
+# ------------------------------------------------------------------ drivers
+
+
+def lint_source(source: str, path: str = "<string>") -> list:
+    """Lint one source string; returns a list of :class:`Finding`."""
+    return _Linter(path, source).run()
+
+
+def lint_file(path: str) -> list:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        return lint_source(source, path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                "AF2L000", "error", path, e.lineno or 0, e.offset or 0,
+                f"syntax error: {e.msg}",
+            )
+        ]
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(
+                d for d in dirs
+                if d not in ("__pycache__", ".git", ".venv", "node_modules")
+            )
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def lint_paths(paths: Iterable[str], select: Optional[set] = None) -> list:
+    """Lint files/directories; ``select`` restricts to those rule ids."""
+    findings: list = []
+    for path in iter_python_files(paths):
+        for f in lint_file(path):
+            if select is None or f.rule in select:
+                findings.append(f)
+    return findings
+
+
+def findings_to_json(findings: list) -> str:
+    return json.dumps(
+        {
+            "tool": "af2_lint",
+            "findings": [f.to_dict() for f in findings],
+            "counts": {
+                sev: sum(1 for f in findings if f.severity == sev)
+                for sev in SEVERITIES
+            },
+        },
+        indent=2,
+    )

@@ -1,0 +1,322 @@
+"""Mosaic TPU lowering gate — the auditor's pre-hardware rule set.
+
+Formerly the whole of ``scripts/check_tpu_lowering.py`` (that script is now
+a thin shim over this module, and ``python -m
+alphafold2_tpu.analysis.jaxpr_audit --rules lowering`` folds these cases
+into the same findings stream as the jaxpr rules — one lowering-gate entry
+point).
+
+Round 4's only compiled-mode Pallas attempt on a real chip died in Mosaic's
+``_check_block_mappings`` — an error class interpret-mode tests can never
+surface, because interpret mode skips the Mosaic lowering entirely
+(VERDICT r4 weak #3). This gate runs the FULL Mosaic lowering pipeline on a
+CPU-only host via JAX's cross-platform AOT path::
+
+    jax.jit(f).trace(*args).lower(lowering_platforms=("tpu",))
+
+which executes ``jax._src.pallas.mosaic.lowering.lower_jaxpr_to_module`` —
+block-mapping tiling checks, scratch allocation, op lowering, the works —
+without any TPU backend. Every kernel entry point is lowered at the exact
+shapes ``scripts/tpu_session.py stage_pallas`` runs on hardware, plus the
+stock flash-attention kernel at the shapes ``ops/flash.py`` feeds it from
+the axial/cross attention paths.
+
+A NEGATIVE CONTROL lowers a deliberately mis-tiled kernel (the round-4
+(1, block) row-stat bug class) and requires the gate to reject it — proving
+the gate actually detects what it claims to.
+
+IMPORTANT: this module imports jax at import time. In an axon-hooked
+environment the cross-platform trace hangs, so run it through the shim
+(``python scripts/check_tpu_lowering.py``, which scrubs and re-execs
+before any jax import) or in a subprocess built with
+``preflight.scrub_axon_env()`` — exactly what ``jaxpr_audit
+--rules lowering`` does. Running this module directly as ``__main__``
+re-execs itself through a scrubbed environment as a last line of defense.
+
+Prints one JSON line per case; exit 0 iff every positive case lowers AND
+the negative control is rejected.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def lower_for_tpu(fn, *args) -> None:
+    """Run the full Mosaic TPU lowering of ``fn(*args)`` on this (CPU)
+    host; raises exactly what a real-chip compile's lowering phase would."""
+    jax.jit(fn).trace(*args).lower(lowering_platforms=("tpu",))
+
+
+def _sparse_inputs(n: int, block_size: int):
+    """The exact configuration stage_pallas measures on hardware
+    (scripts/tpu_session.py): 4 heads, head dim 64, 17 padded tail keys."""
+    from alphafold2_tpu.ops.sparse import BlockSparseConfig
+
+    cfg = BlockSparseConfig(
+        block_size=block_size, num_local_blocks=4, num_global_blocks=1,
+        num_random_blocks=None,
+    )
+    layout = cfg.layout(n)
+    k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+    shape = (1, 4, n, 64)
+    q = jax.random.normal(k1, shape, jnp.float32)
+    k = jax.random.normal(k2, shape, jnp.float32)
+    v = jax.random.normal(k3, shape, jnp.float32)
+    mask = jnp.ones((1, n), bool).at[:, -17:].set(False)
+    return q, k, v, layout, mask
+
+
+def case_block_sparse_fwd(n=512, block_size=128, with_lse=True):
+    from alphafold2_tpu.ops.pallas.block_sparse import (
+        pallas_block_sparse_attention,
+    )
+
+    q, k, v, layout, mask = _sparse_inputs(n, block_size)
+
+    def f(q, k, v):
+        return pallas_block_sparse_attention(
+            q, k, v, layout, block_size, mask=mask, interpret=False,
+            return_lse=with_lse,
+        )
+
+    lower_for_tpu(f, q, k, v)
+
+
+def case_block_sparse_bwd(n=512, block_size=128):
+    from alphafold2_tpu.ops.pallas.block_sparse import (
+        pallas_block_sparse_attention,
+        pallas_block_sparse_attention_bwd,
+    )
+
+    q, k, v, layout, mask = _sparse_inputs(n, block_size)
+
+    def f(q, k, v):
+        out, lse = pallas_block_sparse_attention(
+            q, k, v, layout, block_size, mask=mask, interpret=False,
+            return_lse=True,
+        )
+        return pallas_block_sparse_attention_bwd(
+            q, k, v, out, lse, jnp.ones_like(out), layout, block_size,
+            mask=mask, interpret=False,
+        )
+
+    lower_for_tpu(f, q, k, v)
+
+
+def case_block_sparse_custom_vjp(n=512, block_size=128):
+    """The composed custom_vjp wrapper the model actually calls — grads
+    through it exercise fwd+dq+dkv inside one traced program."""
+    from alphafold2_tpu.ops import pallas as _p  # noqa: F401
+    import alphafold2_tpu.ops.sparse as sparse
+
+    q, k, v, layout, mask = _sparse_inputs(n, block_size)
+
+    def loss(q, k, v):
+        o = sparse.block_sparse_attention_pallas(
+            q, k, v, layout, block_size, mask=mask, interpret=False,
+        )
+        return jnp.sum(o * o)
+
+    lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
+def _stock_flash(q_shape, kv_shape):
+    """The stock jax flash kernel at the (pre-padded, segment-id-masked)
+    shapes ops/flash.py produces for the axial and compressed-cross paths."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        SegmentIds,
+        flash_attention as _fa,
+    )
+
+    b, h, nq, d = q_shape
+    nk = kv_shape[2]
+    q = jnp.ones(q_shape, jnp.float32)
+    k = jnp.ones(kv_shape, jnp.float32)
+    v = jnp.ones(kv_shape, jnp.float32)
+    qs = jnp.ones((b, nq), jnp.int32)
+    ks = jnp.ones((b, nk), jnp.int32)
+
+    def f(q, k, v):
+        return _fa(
+            q, k, v, segment_ids=SegmentIds(q=qs, kv=ks), sm_scale=0.125
+        )
+
+    lower_for_tpu(f, q, k, v)
+
+
+def case_flash_axial_256():
+    # axial row/col pass at crop 256: (B*N, H, N, D) with B*N folded small
+    _stock_flash((4, 8, 256, 64), (4, 8, 256, 64))
+
+
+def case_flash_compressed_cross():
+    # pair-stream queries (crop 64 -> 4096 tokens) against a 128-padded
+    # compressed MSA context — the ops/flash.py wrapper's padded geometry
+    _stock_flash((1, 8, 4096, 64), (1, 8, 128, 64))
+
+
+def case_flash_bwd_256():
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        SegmentIds,
+        flash_attention as _fa,
+    )
+
+    shape = (2, 8, 256, 64)
+    q = jnp.ones(shape, jnp.float32)
+    k = jnp.ones(shape, jnp.float32)
+    v = jnp.ones(shape, jnp.float32)
+    qs = jnp.ones((2, 256), jnp.int32)
+
+    def loss(q, k, v):
+        o = _fa(q, k, v, segment_ids=SegmentIds(q=qs, kv=qs), sm_scale=0.125)
+        return jnp.sum(o * o)
+
+    lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
+def case_negative_control():
+    """The round-4 bug class, reconstructed: a (1, block) row-stat output
+    block on a (rows, n) array. The gate MUST reject it — if this lowers,
+    the gate is not checking what it claims and the run fails."""
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    def f(x):
+        return pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct((4, 512), jnp.float32),
+            grid=(4,),
+            in_specs=[pl.BlockSpec((1, 512), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((1, 512), lambda i: (i, 0)),
+        )(x)
+
+    x = jnp.ones((4, 512), jnp.float32)
+    try:
+        lower_for_tpu(f, x)
+    except Exception as e:
+        if _is_mosaic_tiling_rejection(e):
+            return  # gate correctly rejects the round-4 bug class
+        raise
+    raise AssertionError(
+        "negative control LOWERED: the gate is not exercising Mosaic's "
+        "tiling checks (jax behavior change?) — do not trust green results"
+    )
+
+
+def _is_mosaic_tiling_rejection(e: BaseException) -> bool:
+    """Does this exception look like Mosaic's lowering rejecting the
+    mis-tiled kernel? The old exact-substring match ('divisible by 8 and
+    128') turned into a false RED whenever JAX reworded the message; accept
+    any error that (a) mentions tiling/block-shape vocabulary, or (b) was
+    raised from inside the Pallas/Mosaic lowering code, chained causes
+    included. The hard failure stays only for the case that matters: the
+    bad kernel lowering CLEANLY."""
+    seen = set()
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        msg = str(e).lower()
+        if any(
+            s in msg
+            for s in (
+                "divisible by",
+                "tiling",
+                "tile",
+                "block shape",
+                "block_shape",
+                "layout",
+            )
+        ):
+            return True
+        tb = e.__traceback__
+        while tb is not None:
+            fname = tb.tb_frame.f_code.co_filename.lower()
+            if "pallas" in fname or "mosaic" in fname:
+                return True
+            tb = tb.tb_next
+        e = e.__cause__ or e.__context__
+    return False
+
+
+CASES = [
+    ("block_sparse_fwd_n512", lambda: case_block_sparse_fwd(512)),
+    ("block_sparse_fwd_nolse_n512",
+     lambda: case_block_sparse_fwd(512, with_lse=False)),
+    ("block_sparse_fwd_n1024", lambda: case_block_sparse_fwd(1024)),
+    ("block_sparse_bwd_n512", lambda: case_block_sparse_bwd(512)),
+    ("block_sparse_bwd_n1024", lambda: case_block_sparse_bwd(1024)),
+    ("block_sparse_custom_vjp_n512", case_block_sparse_custom_vjp),
+    ("flash_axial_256", case_flash_axial_256),
+    ("flash_compressed_cross", case_flash_compressed_cross),
+    ("flash_bwd_256", case_flash_bwd_256),
+    ("negative_control_rejects_bad_tiling", case_negative_control),
+]
+
+
+def run_gate(names=()) -> tuple:
+    """Run the named cases (all when empty). Returns (records, failed)."""
+    run = [(n, f) for n, f in CASES if not names or n in names]
+    records = []
+    failed = []
+    for name, fn in run:
+        t0 = time.monotonic()
+        try:
+            fn()
+            rec = {"case": name, "ok": True}
+        except Exception as e:
+            failed.append(name)
+            rec = {
+                "case": name, "ok": False,
+                "error": f"{type(e).__name__}: {str(e)[:500]}",
+            }
+        rec["seconds"] = round(time.monotonic() - t0, 1)
+        records.append(rec)
+    return records, failed
+
+
+def main(argv=None) -> int:
+    names = (argv or sys.argv)[1:]
+    unknown = sorted(set(names) - {n for n, _ in CASES})
+    if unknown:
+        # a typo'd case name must be a loud red, not a zero-case run that
+        # exits green having certified nothing
+        print(json.dumps({
+            "gate": "tpu_lowering",
+            "error": f"unknown case name(s): {unknown}",
+            "known": [n for n, _ in CASES],
+        }), flush=True)
+        return 2
+    records, failed = run_gate(names)
+    for rec in records:
+        print(json.dumps(rec), flush=True)
+    print(json.dumps({
+        "gate": "tpu_lowering", "cases": len(records), "failed": failed,
+    }), flush=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    # last line of defense for direct `python -m` runs in a hooked env:
+    # re-exec through a scrubbed environment (jax is already imported in
+    # THIS process, but execve replaces the process wholesale)
+    import os
+
+    if os.environ.get("AF2TPU_LOWERING_GATE_SCRUBBED") != "1":
+        from alphafold2_tpu.preflight import scrub_axon_env
+
+        env = scrub_axon_env()
+        env["AF2TPU_LOWERING_GATE_SCRUBBED"] = "1"
+        os.execve(
+            sys.executable,
+            [sys.executable, "-m", "alphafold2_tpu.analysis.lowering"]
+            + sys.argv[1:],
+            env,
+        )
+    sys.exit(main())

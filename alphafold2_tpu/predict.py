@@ -57,7 +57,9 @@ def realize_structure(
     residue_mask = None
     if mask is not None:
         pair_valid = mask[:, :, None] & mask[:, None, :]
-        weights = weights * pair_valid
+        # explicit bool->float cast: bool*float is an implicit promotion
+        # the strict-promotion audit (jaxpr_audit AF2A105) forbids
+        weights = weights * pair_valid.astype(weights.dtype)
         if fix_mirror:
             b, n = mask.shape
             residue_mask = mask.reshape(b, n // 3, 3).any(-1)  # (B, L)

@@ -163,7 +163,12 @@ def structure_loss(out: dict, backbone_true: jnp.ndarray, mask: jnp.ndarray):
     sq = jnp.sum((aligned - centered) ** 2, axis=-2) * mask3
     rmsd_val = jnp.sqrt(jnp.sum(sq, axis=-1) / denom)
     w = out["weights"]
-    disp = jnp.mean(jnp.abs(1.0 / jnp.clip(w, 1e-7, None) - 1.0) * (w > 0), axis=(-1, -2))
+    # explicit bool->float cast (strict-promotion audit AF2A105)
+    disp = jnp.mean(
+        jnp.abs(1.0 / jnp.clip(w, 1e-7, None) - 1.0)
+        * (w > 0).astype(w.dtype),
+        axis=(-1, -2),
+    )
     return jnp.mean(rmsd_val + 0.1 * disp), {
         "rmsd": jnp.mean(rmsd_val),
         "dispersion": jnp.mean(disp),
@@ -237,12 +242,16 @@ def init_end2end_state(cfg: Config, model: End2EndModel, batch: dict) -> TrainSt
         msa_mask=opt("msa_mask"),
         embedds=opt("embedds"),
     )
-    return TrainState.create(
+    state = TrainState.create(
         apply_fn=model.apply,
         params=params,
         tx=build_optimizer(cfg),
         skipped=jnp.zeros((), jnp.int32),
     )
+    # flax's create() sets step to the python int 0; keep every state leaf
+    # on device so the first jitted step performs no implicit host->device
+    # transfer (jax.transfer_guard("disallow") clean — tests/conftest.py)
+    return state.replace(step=jnp.zeros((), jnp.int32))
 
 
 def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):

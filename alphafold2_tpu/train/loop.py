@@ -53,7 +53,10 @@ def distogram_cross_entropy(
     # last forward tensor, so a first-NaN here means the loss itself, not
     # the trunk, went bad
     nll = numerics.tag("loss.distogram_nll", nll)
-    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+    # explicit bool->float cast: bool*float is an implicit promotion the
+    # strict-promotion audit (analysis/jaxpr_audit.py AF2A105) forbids
+    validf = valid.astype(nll.dtype)
+    return jnp.sum(nll * validf) / jnp.maximum(jnp.sum(validf), 1.0)
 
 
 def apply_features(data_iter, cfg: Config):
@@ -155,12 +158,16 @@ def init_state(cfg: Config, model: Alphafold2, sample_batch: dict) -> TrainState
         from alphafold2_tpu.models.init import torch_match_reinit
 
         params = torch_match_reinit(params, rng)
-    return TrainState.create(
+    state = TrainState.create(
         apply_fn=model.apply,
         params=params,
         tx=build_optimizer(cfg),
         skipped=jnp.zeros((), jnp.int32),
     )
+    # flax's create() sets step to the python int 0; keep every state leaf
+    # on device so the first jitted step performs no implicit host->device
+    # transfer (jax.transfer_guard("disallow") clean — tests/conftest.py)
+    return state.replace(step=jnp.zeros((), jnp.int32))
 
 
 def tiny_batch_like(sample_batch: dict, n: int = 16, m: int = 2) -> dict:

@@ -154,8 +154,11 @@ def _lddt_from_distances(
     delta = jnp.abs(d_true - d_pred)
     th = jnp.asarray(thresholds, dtype=delta.dtype)
     ok = (delta[..., None] < th).astype(delta.dtype).mean(-1)  # (..., N, N)
-    denom = jnp.maximum(incl.sum((-1, -2)), 1)
-    return jnp.sum(ok * incl, axis=(-1, -2)) / denom
+    # explicit bool->float casts: bool*float and float/int are implicit
+    # promotions the strict-promotion audit (jaxpr_audit AF2A105) forbids
+    inclf = incl.astype(delta.dtype)
+    denom = jnp.maximum(inclf.sum((-1, -2)), 1.0)
+    return jnp.sum(ok * inclf, axis=(-1, -2)) / denom
 
 
 def lddt(

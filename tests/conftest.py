@@ -29,3 +29,38 @@ if not os.environ.get("AF2TPU_TEST_TPU"):
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def strict_promotion():
+    """Opt-in graph-hygiene fixture: every trace inside the test runs under
+    strict dtype promotion, so an implicit bool/int-into-float promotion
+    raises instead of silently widening — the runtime twin of the jaxpr
+    auditor's AF2A105 rule (alphafold2_tpu/analysis/jaxpr_audit.py).
+
+    List setup fixtures BEFORE this one in the test signature: fixtures
+    instantiate in signature order, so earlier setup stays outside the
+    strict context.
+    """
+    import jax
+
+    with jax.numpy_dtype_promotion("strict"):
+        yield
+
+
+@pytest.fixture
+def no_implicit_transfers():
+    """Opt-in graph-hygiene fixture: any implicit host<->device transfer
+    inside the test raises (jax.transfer_guard("disallow")). Explicit
+    jax.device_put / jax.device_get remain allowed — which is the point:
+    the serve/train hot paths must only ever transfer explicitly.
+
+    Setup that builds params or PRNG keys (jax.random.key transfers its
+    seed scalar) belongs in a fixture listed BEFORE this one.
+    """
+    import jax
+
+    with jax.transfer_guard("disallow"):
+        yield

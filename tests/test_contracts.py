@@ -1,0 +1,164 @@
+"""Graph-contract tests: fingerprints are deterministic, every contract
+field change produces a readable diff line (op-count drift, recompile-key
+input/treedef changes, donation changes), the check verdict machinery
+mirrors observe.regress's explicit third states (stale/missing baseline),
+and the CLI round-trips a baseline through --update/--check."""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import pytest
+
+from alphafold2_tpu.analysis import contracts
+from alphafold2_tpu.analysis.targets import TraceTarget
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def synthetic(name="syn", fn=None, args=None, donate=()):
+    fn = fn if fn is not None else (lambda x: jnp.sin(x) * 2.0 + 1.0)
+    args = args if args is not None else (jnp.ones((4, 4)),)
+    return TraceTarget(
+        name=name, build=lambda: (fn, args), donate_argnums=donate
+    )
+
+
+def test_fingerprint_is_deterministic():
+    t = synthetic()
+    a = contracts.fingerprint_target(t)
+    b = contracts.fingerprint_target(t)
+    assert a == b
+    assert a["ops"].get("sin") == 1
+    assert a["n_eqns"] == sum(a["ops"].values())
+    assert a["inputs"] == ["float32[4, 4]"]
+
+
+def test_compute_contracts_records_jax_version():
+    import jax
+
+    doc = contracts.compute_contracts([synthetic()])
+    assert doc["jax_version"] == jax.__version__
+    assert doc["format"] == contracts.FORMAT_VERSION
+    assert set(doc["targets"]) == {"syn"}
+
+
+# -------------------------------------------------------------------- diff
+
+
+def _base_doc():
+    return contracts.compute_contracts([synthetic()])
+
+
+def test_identical_contracts_have_no_diff():
+    doc = _base_doc()
+    assert contracts.diff_contracts(doc, copy.deepcopy(doc)) == []
+
+
+def test_op_count_drift_is_named_per_primitive():
+    doc = _base_doc()
+    drifted = copy.deepcopy(doc)
+    drifted["targets"]["syn"]["ops"]["sin"] += 2
+    drifted["targets"]["syn"]["ops"]["dot_general"] = 5
+    lines = contracts.diff_contracts(doc, drifted)
+    assert any("sin: 1 -> 3 (+2)" in l for l in lines), lines
+    assert any("dot_general: 0 -> 5 (+5)" in l for l in lines), lines
+
+
+def test_input_signature_change_is_a_recompile_key():
+    doc = _base_doc()
+    drifted = copy.deepcopy(doc)
+    drifted["targets"]["syn"]["inputs"] = ["float32[8, 8]"]
+    lines = contracts.diff_contracts(doc, drifted)
+    assert any("RECOMPILE KEY" in l and "float32[8, 8]" in l for l in lines)
+
+
+def test_treedef_donation_and_target_set_changes():
+    doc = _base_doc()
+    drifted = copy.deepcopy(doc)
+    drifted["targets"]["syn"]["in_treedef"] = "PyTreeDef({'other': *})"
+    drifted["targets"]["syn"]["donation"] = [0]
+    drifted["targets"]["extra"] = drifted["targets"]["syn"]
+    lines = contracts.diff_contracts(doc, drifted)
+    assert any("treedef changed" in l for l in lines)
+    assert any("donation map changed" in l for l in lines)
+    assert any("extra: new target" in l for l in lines)
+    removed = contracts.diff_contracts(drifted, doc)
+    assert any("extra: target removed" in l for l in removed)
+
+
+# ----------------------------------------------------------------- verdicts
+
+
+def test_check_against_pass_drift_and_stale(tmp_path):
+    t = synthetic()
+    baseline = tmp_path / "graph_contracts.json"
+    baseline.write_text(json.dumps(contracts.compute_contracts([t])))
+
+    result = contracts.check_against(str(baseline), [t])
+    assert result["verdict"] == "pass"
+    assert result["diffs"] == []
+
+    # synthetic op-count drift: the acceptance scenario the CI job gates
+    doc = json.loads(baseline.read_text())
+    doc["targets"]["syn"]["ops"]["sin"] = 99
+    baseline.write_text(json.dumps(doc))
+    result = contracts.check_against(str(baseline), [t])
+    assert result["verdict"] == "drift"
+    assert any("sin: 99 -> 1" in l for l in result["diffs"])
+
+    # a baseline traced under another jax is stale, not a repo regression
+    doc["jax_version"] = "0.0.1"
+    baseline.write_text(json.dumps(doc))
+    result = contracts.check_against(str(baseline), [t])
+    assert result["verdict"] == "stale-baseline"
+    assert "re-baseline" in result["reason"]
+
+
+def test_missing_baseline_is_explicit(tmp_path):
+    result = contracts.check_against(str(tmp_path / "nope.json"), [synthetic()])
+    assert result["verdict"] == "missing-baseline"
+
+
+# ------------------------------------------------------------ real targets
+
+
+@pytest.mark.slow
+def test_committed_contracts_hold():
+    """The committed graph_contracts.json matches the code — the CI
+    graph-contract job's in-suite twin (skips when the environment's jax
+    differs from the baseline's, exactly like the CLI)."""
+    result = contracts.check_against(contracts.DEFAULT_BASELINE)
+    assert result["verdict"] in ("pass", "stale-baseline"), result
+    if result["verdict"] == "pass":
+        assert result["diffs"] == []
+
+
+@pytest.mark.slow
+def test_cli_update_check_roundtrip_and_drift_rc(tmp_path):
+    """CLI round-trip on the real registry: --update writes a baseline
+    --check accepts (rc 0); an injected op drift flips rc to 1 with the
+    primitive named."""
+    baseline = tmp_path / "contracts.json"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    run = lambda *a: subprocess.run(
+        [sys.executable, "-m", "alphafold2_tpu.analysis.contracts", *a],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    proc = run("--update", "--baseline", str(baseline))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = run("--check", "--baseline", str(baseline))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "verdict=pass" in proc.stdout
+
+    doc = json.loads(baseline.read_text())
+    name = next(iter(doc["targets"]))
+    prim = next(iter(doc["targets"][name]["ops"]))
+    doc["targets"][name]["ops"][prim] += 7
+    baseline.write_text(json.dumps(doc))
+    proc = run("--check", "--baseline", str(baseline))
+    assert proc.returncode == 1
+    assert "DRIFT" in proc.stdout and prim in proc.stdout

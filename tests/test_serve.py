@@ -145,9 +145,11 @@ def test_bucket_padding_parity_across_shapes():
     np.testing.assert_allclose(r16.weights, r8.weights, atol=1e-5)
 
 
+@pytest.mark.usefixtures("no_implicit_transfers")
 def test_batching_parity(engine):
     """A request's output must not depend on what else rides in the batch
-    or which slot it lands in."""
+    or which slot it lands in. Runs under jax.transfer_guard("disallow"):
+    the whole serve path must transfer explicitly (conftest fixture)."""
     a = ServeRequest("ACDEFG", seed=11)
     solo = engine.predict_many([a])[0]
     batched = engine.predict_many(
@@ -157,6 +159,7 @@ def test_batching_parity(engine):
     np.testing.assert_allclose(batched.weights, solo.weights, atol=1e-6)
 
 
+@pytest.mark.usefixtures("no_implicit_transfers")
 def test_results_align_with_requests(engine):
     reqs = ["ACDEFGHKLM", "AC", "ACDEFGHKLMNPQRSTVW"]
     out = engine.predict_many(reqs)
@@ -168,6 +171,29 @@ def test_results_align_with_requests(engine):
         assert np.all(np.isfinite(r.atom14))
         assert r.latency_s > 0
         assert r.distogram is None  # return_distogram defaults off
+
+
+def test_serve_trace_strict_and_transfer_clean(
+    fresh_engine, strict_promotion, no_implicit_transfers
+):
+    """Trace + compile + dispatch of a fresh engine under BOTH graph-
+    hygiene guards: strict dtype promotion (no implicit bool/int->float
+    widening anywhere in the serve graph) and disallowed implicit
+    transfers (every host<->device hop in the dispatch path is explicit).
+    Fixture order matters: the engine (params, PRNG keys) is built before
+    the guards engage."""
+    out = fresh_engine.predict_many(["ACDEFG", "MK"])
+    assert out[0].atom14.shape == (6, 14, 3)
+    assert out[1].atom14.shape == (2, 14, 3)
+    assert np.all(np.isfinite(out[0].atom14))
+    assert fresh_engine.stats()["serve.compiles"] == 1
+
+
+@pytest.fixture
+def fresh_engine():
+    # function-scoped: nothing compiled yet, so the guarded test above
+    # exercises trace+compile, not just a cache-hit dispatch
+    return ServeEngine(_cfg(buckets=(8,), max_batch=2))
 
 
 # ------------------------------------------------------- compile accounting
