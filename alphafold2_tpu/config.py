@@ -106,6 +106,13 @@ class ServeConfig:
     mds_iters: int = 200  # structure-realization Guttman iterations
     donate_buffers: bool = True  # donate per-request feature buffers to XLA
     return_distogram: bool = False  # ship (3L,3L,K) logits back per request
+    # --- async frontend (serve/scheduler.py: AsyncServeFrontend) ---
+    queue_depth: int = 64  # bounded admission queue; full -> structured reject
+    dwell_ms: float = 25.0  # max wait for batch fill before partial dispatch
+    default_deadline_s: float = 0.0  # per-request deadline; 0 = none
+    cache_size: int = 256  # (seq, seed)-keyed LRU result entries; 0 disables
+    shed_watermark: float = 0.75  # queue fraction where low-priority sheds
+    retry_failed: bool = True  # retry a failed dispatch on another executable
 
 
 @dataclass

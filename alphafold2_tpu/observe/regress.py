@@ -41,6 +41,31 @@ DEFAULT_THRESHOLDS = {
     "p99_ms": ("lower", 0.50),
 }
 
+# serve-async (open-loop frontend) records: the headline is goodput and
+# tail latency under offered load, plus the admission-control outcome —
+# each with its own direction so the gate yields real per-metric verdicts
+# instead of falling back to no-data on the shape. Tolerances are wider
+# still: open-loop records compare across machines (a committed CPU-mesh
+# baseline vs a CI runner), where absolute speed legitimately varies —
+# the gate exists for order-of-magnitude cliffs (a lost cache, a dwell
+# misconfiguration, rejection storms), not machine-to-machine jitter.
+SERVE_ASYNC_THRESHOLDS = {
+    "value": ("higher", 0.50),  # ok-residues/sec over the open-loop window
+    "goodput_rps": ("higher", 0.50),  # completed requests/sec
+    "p50_ms": ("lower", 2.00),
+    "p95_ms": ("lower", 2.00),
+    "p99_ms": ("lower", 2.00),
+    "rejection_rate": ("lower", 1.00),
+}
+
+
+def thresholds_for(record) -> dict:
+    """The gate's per-metric direction/tolerance table for this record's
+    shape (keyed by the record's ``mode``)."""
+    if isinstance(record, dict) and record.get("mode") == "serve-async":
+        return SERVE_ASYNC_THRESHOLDS
+    return DEFAULT_THRESHOLDS
+
 
 def record_invalid_reason(rec) -> Optional[str]:
     """Why this record is NOT a usable measurement (None = it is)."""

@@ -1,8 +1,13 @@
 """Serving layer: shape-bucketed, batched inference with compile accounting.
 
-See :mod:`alphafold2_tpu.serve.engine` (the engine) and
-:mod:`alphafold2_tpu.serve.bucketing` (the ladder math). Configured by
-``config.ServeConfig``; benched by ``bench.py --mode serve``.
+See :mod:`alphafold2_tpu.serve.engine` (the synchronous batched engine),
+:mod:`alphafold2_tpu.serve.bucketing` (the ladder math),
+:mod:`alphafold2_tpu.serve.scheduler` (the async open-loop frontend:
+admission control, deadlines, continuous batch formation),
+:mod:`alphafold2_tpu.serve.cache` (LRU result cache + in-flight dedup) and
+:mod:`alphafold2_tpu.serve.faults` (deterministic fault injection).
+Configured by ``config.ServeConfig``; benched by ``bench.py --mode serve``
+(closed loop) and ``--mode serve-async`` (open loop, Poisson arrivals).
 """
 
 from alphafold2_tpu.serve.bucketing import (
@@ -11,9 +16,17 @@ from alphafold2_tpu.serve.bucketing import (
     padding_fraction,
     validate_ladder,
 )
+from alphafold2_tpu.serve.cache import ResultCache
 from alphafold2_tpu.serve.engine import ServeEngine, ServeRequest, ServeResult
+from alphafold2_tpu.serve.faults import FaultPlan, InjectedFault
+from alphafold2_tpu.serve.scheduler import AsyncServeFrontend, PendingResult
 
 __all__ = [
+    "AsyncServeFrontend",
+    "FaultPlan",
+    "InjectedFault",
+    "PendingResult",
+    "ResultCache",
     "ServeEngine",
     "ServeRequest",
     "ServeResult",

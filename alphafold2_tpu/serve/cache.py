@@ -1,0 +1,103 @@
+"""Sequence-keyed LRU result cache with in-flight request dedup.
+
+Identical requests are common in production serving (the same viral
+sequence submitted by thousands of users), and the engine's outputs are
+deterministic in ``(seq, seed)`` whatever bucket or batch slot the request
+lands in (pinned by the serve parity tests) — so recomputing them is pure
+waste. Two layers remove it:
+
+- **LRU cache** — completed results keyed by ``(seq, seed)``; a hit
+  returns the stored :class:`~alphafold2_tpu.serve.engine.ServeResult`
+  (same arrays — byte-identical to the dispatch that produced it).
+- **In-flight dedup** — a request whose key is already queued or on the
+  device *joins* the in-flight entry as a follower instead of dispatching
+  again; when the leader's dispatch completes, every follower is resolved
+  with the same result. Dedup works even with the LRU disabled
+  (``capacity=0``): concurrent identical requests still share one
+  dispatch, they just aren't remembered afterwards.
+
+The cache stores and returns results; it never stamps latencies or bumps
+counters — the scheduler owns per-request accounting. Pure stdlib.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+
+class InFlightEntry:
+    """One key's in-flight record: the leader token plus the follower
+    contexts (opaque to the cache — the scheduler registers its pending
+    handles here) to resolve when the leader's dispatch completes."""
+
+    __slots__ = ("key", "followers")
+
+    def __init__(self, key):
+        self.key = key
+        self.followers: list = []
+
+
+class ResultCache:
+    """Thread-safe LRU + in-flight table over ``(seq, seed)`` keys.
+
+    Protocol (scheduler side):
+
+    1. ``status, payload = lookup_or_claim(key, follower_ctx)`` at submit:
+       ``"hit"`` (payload = cached result, done), ``"follower"``
+       (``follower_ctx`` was registered on the in-flight entry; the leader
+       will resolve it), or ``"leader"`` (payload = the new
+       :class:`InFlightEntry`; the caller must eventually ``fulfill``).
+    2. ``followers = fulfill(key, result, cache=...)`` when the leader's
+       dispatch (or rejection/deadline) resolves: stores ``result`` in the
+       LRU when ``cache=True`` (only genuinely-ok results belong there)
+       and returns the follower contexts for the caller to resolve.
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = max(0, int(capacity))
+        self._lru: "OrderedDict" = OrderedDict()
+        self._inflight: dict = {}
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._lru)
+
+    def lookup_or_claim(self, key, follower_ctx=None) -> Tuple[str, object]:
+        with self._lock:
+            if key in self._lru:
+                self._lru.move_to_end(key)
+                return "hit", self._lru[key]
+            entry = self._inflight.get(key)
+            if entry is not None:
+                if follower_ctx is not None:
+                    entry.followers.append(follower_ctx)
+                return "follower", entry
+            entry = InFlightEntry(key)
+            self._inflight[key] = entry
+            return "leader", entry
+
+    def fulfill(self, key, result, cache: bool = True) -> list:
+        with self._lock:
+            entry = self._inflight.pop(key, None)
+            if cache and self.capacity:
+                self._lru[key] = result
+                self._lru.move_to_end(key)
+                while len(self._lru) > self.capacity:
+                    self._lru.popitem(last=False)
+            return list(entry.followers) if entry is not None else []
+
+    def peek(self, key) -> Optional[object]:
+        """Cached result without LRU promotion (tests, introspection)."""
+        with self._lock:
+            return self._lru.get(key)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._lru),
+                "capacity": self.capacity,
+                "inflight": len(self._inflight),
+            }

@@ -58,23 +58,51 @@ from alphafold2_tpu.train.end2end import End2EndModel
 class ServeRequest:
     """One inference request. ``seed`` drives the synthesized-MSA sampling
     (and nothing else), so identical (seq, seed) requests are reproducible
-    whatever bucket or batch slot they land in."""
+    whatever bucket or batch slot they land in.
+
+    ``arrival_s`` is the request's own arrival timestamp on the
+    ``time.perf_counter`` timebase: when present, queue-wait accounting is
+    per request instead of per stream (requests dispatched in a later
+    bucket no longer accrue earlier buckets' dispatch time as "queue
+    wait"). The async frontend (serve/scheduler.py) stamps it at submit;
+    ``priority`` and ``deadline_s`` (relative seconds, 0/None = none) are
+    likewise scheduler inputs that ride with the request."""
 
     seq: str
     seed: int = 0
+    arrival_s: Optional[float] = None
+    priority: int = 0
+    deadline_s: Optional[float] = None
 
 
 @dataclasses.dataclass
 class ServeResult:
+    """One request's outcome. ``status`` is the structured failure
+    taxonomy: ``"ok"`` (arrays populated), ``"error"`` (dispatch raised —
+    converted, never propagated, so a batch partner's poison pill cannot
+    crash the caller), ``"rejected"`` (admission control turned the request
+    away; ``retry_after_s`` hints when to come back), or
+    ``"deadline_exceeded"`` (the request's deadline passed while queued).
+    Non-``ok`` results carry ``None`` arrays and an ``error`` message."""
+
     seq: str
     bucket: int
-    atom14: np.ndarray  # (L, 14, 3) refined all-atom coordinates
-    backbone: np.ndarray  # (L, 3, 3) N/CA/C
-    weights: np.ndarray  # (3L, 3L) distogram confidence (valid region)
-    distogram: Optional[np.ndarray]  # (3L, 3L, K) logits when requested
-    latency_s: float  # queue wait + dispatch: what a caller observes
+    atom14: Optional[np.ndarray] = None  # (L, 14, 3) refined all-atom coords
+    backbone: Optional[np.ndarray] = None  # (L, 3, 3) N/CA/C
+    weights: Optional[np.ndarray] = None  # (3L, 3L) distogram confidence
+    distogram: Optional[np.ndarray] = None  # (3L, 3L, K) logits if requested
+    latency_s: float = 0.0  # queue wait + dispatch: what a caller observes
     queue_wait_s: float = 0.0  # time between arrival and dispatch start
     dispatch_s: float = 0.0  # device execution + result fetch of the batch
+    status: str = "ok"  # "ok" | "error" | "rejected" | "deadline_exceeded"
+    error: Optional[str] = None  # failure detail for non-"ok" statuses
+    retry_after_s: Optional[float] = None  # backoff hint on "rejected"
+    cache_hit: bool = False  # served from the result cache / in-flight dedup
+    retried: bool = False  # produced by the scheduler's retry dispatch
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"
 
 
 def _as_request(r: Union[str, ServeRequest]) -> ServeRequest:
@@ -109,7 +137,12 @@ class ServeEngine:
         checkpoint_dir: Optional[str] = None,
         counters: Optional[EventCounters] = None,
         tracer: Optional[Tracer] = None,
+        faults=None,
     ):
+        # faults: an optional serve.faults.FaultPlan consulted at the top of
+        # every dispatch — the injection point that makes the scheduler's
+        # retry and graceful-degradation paths testable
+        self.faults = faults
         self.cfg = cfg
         self.buckets = validate_ladder(cfg.serve.buckets)
         self.max_batch = int(cfg.serve.max_batch)
@@ -293,16 +326,76 @@ class ServeEngine:
                 )
         return results
 
+    def dispatch_batch(
+        self, bucket: int, requests: Sequence[Union[str, ServeRequest]]
+    ) -> list:
+        """Dispatch one pre-formed batch at ``bucket`` and return its
+        results in order. The async frontend (serve/scheduler.py) forms its
+        own batches and calls this; per-request ``arrival_s`` stamps drive
+        the queue-wait accounting. A dispatch failure yields structured
+        ``status="error"`` results, never an exception."""
+        reqs = [_as_request(r) for r in requests]
+        results: list = [None] * len(reqs)
+        self._dispatch(bucket, reqs, list(range(len(reqs))), results)
+        return results
+
+    def retry_bucket(self, bucket: int) -> Optional[int]:
+        """The next rung up the ladder — a *different* (bucket, batch)
+        executable for the scheduler's retry-with-exclusion path — or None
+        when ``bucket`` is already the largest rung."""
+        i = self.buckets.index(bucket)
+        return self.buckets[i + 1] if i + 1 < len(self.buckets) else None
+
     def _dispatch(self, bucket, chunk_reqs, chunk_idx, results, arrival=None):
         n_real = len(chunk_reqs)
         batch = self.max_batch if self.cfg.serve.pad_batches else n_real
-        self.counters.bump("serve.batches")
+        dispatch_index = self.counters.bump("serve.batches")
         self.counters.bump("serve.padded_slots", batch - n_real)
         t_start = time.perf_counter()
-        queue_wait = t_start - arrival if arrival is not None else 0.0
-        self.histograms["queue_wait_s"].observe(queue_wait)
+        # per-request queue wait when the request carries its own arrival
+        # stamp (the scheduler sets it at submit); the stream-level arrival
+        # is the fallback for the synchronous predict_many path
+        waits = []
+        for r in chunk_reqs:
+            origin = r.arrival_s if r.arrival_s is not None else arrival
+            waits.append(t_start - origin if origin is not None else 0.0)
+            self.histograms["queue_wait_s"].observe(max(0.0, waits[-1]))
         self.histograms["batch_occupancy"].observe(n_real / batch)
 
+        try:
+            self._dispatch_inner(
+                bucket, batch, dispatch_index, chunk_reqs, chunk_idx,
+                results, waits,
+            )
+        except Exception as e:  # noqa: BLE001 — converted, never swallowed
+            # an exception mid-dispatch (device fault, injected fault, OOM)
+            # must not leave the whole chunk's result slots as None with
+            # counters already bumped: every request gets a structured
+            # per-request error result the scheduler can retry against a
+            # different (bucket, batch) executable
+            self.counters.bump("serve.dispatch_errors")
+            msg = f"{type(e).__name__}: {e}"
+            dispatch_s = time.perf_counter() - t_start
+            for slot, (req, idx) in enumerate(zip(chunk_reqs, chunk_idx)):
+                results[idx] = ServeResult(
+                    seq=req.seq,
+                    bucket=bucket,
+                    status="error",
+                    error=msg,
+                    latency_s=max(0.0, waits[slot]) + dispatch_s,
+                    queue_wait_s=max(0.0, waits[slot]),
+                    dispatch_s=dispatch_s,
+                )
+
+    def _dispatch_inner(
+        self, bucket, batch, dispatch_index, chunk_reqs, chunk_idx, results,
+        waits,
+    ):
+        n_real = len(chunk_reqs)
+        if self.faults is not None:
+            # fault-injection hook: may delay (simulating a slow device) or
+            # raise (converted to structured error results by the caller)
+            self.faults.on_dispatch(dispatch_index, bucket)
         with self.tracer.span(
             "serve.batch", bucket=bucket, batch=batch, n_real=n_real
         ) as batch_span:
@@ -369,12 +462,13 @@ class ServeEngine:
             self.memory.counter_to(self.tracer)  # HBM beside the spans
 
             with self.tracer.span("serve.unpad", bucket=bucket):
-                latency = queue_wait + dispatch_s
                 for slot, (req, idx) in enumerate(
                     zip(chunk_reqs, chunk_idx)
                 ):
                     L = len(req.seq)
                     atom14 = refined[slot, :L]
+                    wait = max(0.0, waits[slot])
+                    latency = wait + dispatch_s
                     self.histograms["latency_s"].observe(latency)
                     results[idx] = ServeResult(
                         seq=req.seq,
@@ -387,7 +481,7 @@ class ServeEngine:
                             if disto is not None else None
                         ),
                         latency_s=latency,
-                        queue_wait_s=queue_wait,
+                        queue_wait_s=wait,
                         dispatch_s=dispatch_s,
                     )
 

@@ -1,0 +1,100 @@
+"""Fault injection for the serve dispatch path.
+
+A serving frontend's failure handling is only as real as its tests: the
+retry-with-exclusion path and the graceful-degradation paths (structured
+error results instead of exceptions, rejection under load) are unreachable
+on a healthy backend. ``FaultPlan`` is the injection point: the engine
+consults it at the top of every dispatch (``ServeEngine(faults=plan)``)
+and the plan may *delay* the dispatch (a slow device / congested
+interconnect stand-in) or *fail* it (raise :class:`InjectedFault`, which
+the engine converts to structured per-request error results the scheduler
+retries against a different (bucket, batch) executable).
+
+Plans target a specific dispatch index (``fail_dispatch=N``, 1-based over
+the engine's ``serve.batches`` counter) or every dispatch of a bucket
+(``fail_bucket=B``), and fire at most ``times`` times (0 = unlimited), so
+"the first dispatch of bucket 8 fails once, the retry succeeds" is a
+deterministic scenario instead of a race. Pure stdlib, no jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a :class:`FaultPlan` to simulate a dispatch failure."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """Deterministic dispatch fault/delay injection.
+
+    ``fail_dispatch`` matches the global 1-based dispatch index (the
+    engine's ``serve.batches`` counter value for that dispatch);
+    ``fail_bucket`` matches every dispatch of that bucket. With neither
+    set the plan is inert. A matching dispatch first sleeps ``delay_s``
+    (if any), then raises :class:`InjectedFault` unless ``fail=False``
+    (delay-only plans model slowness without failure). ``fired`` records
+    every injection for test assertions."""
+
+    fail_dispatch: Optional[int] = None  # 1-based dispatch index to hit
+    fail_bucket: Optional[int] = None  # bucket whose dispatches are hit
+    times: int = 1  # max injections (0 = unlimited)
+    delay_s: float = 0.0  # sleep before (optionally) failing
+    fail: bool = True  # False = delay-only plan
+    message: str = "injected fault"
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+        self.fired: list = []
+
+    def _matches(self, dispatch_index: int, bucket: int) -> bool:
+        if self.fail_dispatch is not None and (
+            dispatch_index == self.fail_dispatch
+        ):
+            return True
+        return self.fail_bucket is not None and bucket == self.fail_bucket
+
+    def on_dispatch(self, dispatch_index: int, bucket: int) -> None:
+        """Engine hook: called once per dispatch before any device work."""
+        with self._lock:
+            if self.times and len(self.fired) >= self.times:
+                return
+            if not self._matches(dispatch_index, bucket):
+                return
+            self.fired.append({"dispatch": dispatch_index, "bucket": bucket})
+        if self.delay_s > 0:
+            time.sleep(self.delay_s)
+        if self.fail:
+            raise InjectedFault(
+                f"{self.message} (dispatch {dispatch_index}, bucket {bucket})"
+            )
+
+    @classmethod
+    def from_spec(cls, spec: Optional[str]) -> Optional["FaultPlan"]:
+        """Parse ``"dispatch=2,bucket=16,times=1,delay=0.5,fail=0"`` specs
+        (any subset of keys) — the ``AF2TPU_SERVE_ASYNC_FAULT`` env hook the
+        serve-async bench uses for degradation drills. None/"" -> None."""
+        if not spec:
+            return None
+        kw: dict = {}
+        for part in spec.split(","):
+            key, _, value = part.partition("=")
+            key = key.strip()
+            if key == "dispatch":
+                kw["fail_dispatch"] = int(value)
+            elif key == "bucket":
+                kw["fail_bucket"] = int(value)
+            elif key == "times":
+                kw["times"] = int(value)
+            elif key == "delay":
+                kw["delay_s"] = float(value)
+            elif key == "fail":
+                kw["fail"] = value.strip() not in ("0", "false", "no")
+            else:
+                raise ValueError(f"unknown fault-spec key {key!r} in {spec!r}")
+        return cls(**kw)

@@ -1,0 +1,475 @@
+"""Async serving frontend: admission control, deadlines, dedup, dispatch.
+
+``ServeEngine.predict_many`` is a closed loop: the caller assembles a
+request list, blocks until every dispatch finishes, and nothing bounds how
+much work piles up. This module is the open-loop layer a real frontend
+needs between "user request arrives" and "bucketed batch hits the chip":
+
+- **Bounded priority queue + admission control** — ``submit`` never
+  blocks and never raises: a full queue yields a structured ``rejected``
+  result carrying a ``retry_after_s`` hint, and past a configurable
+  watermark (``serve.shed_watermark``) low-priority requests are load-shed
+  before the queue is full, so high-priority traffic keeps a reserved
+  slice of the queue under overload.
+- **Continuous batch formation** — a background dispatcher thread forms
+  (bucket, batch) groups and dispatches when a group *fills* to
+  ``max_batch`` OR the oldest member has *dwelled* ``serve.dwell_ms`` —
+  the classic fill-vs-latency tradeoff, tunable per deployment.
+- **Per-request deadlines** — a request whose deadline passes while
+  queued resolves to a structured ``deadline_exceeded`` result instead of
+  wasting a dispatch slot (or raising).
+- **Result cache + in-flight dedup** — ``(seq, seed)``-keyed LRU
+  (:mod:`alphafold2_tpu.serve.cache`): repeats resolve immediately with
+  byte-identical arrays, and concurrent identical requests share one
+  dispatch.
+- **Fault tolerance** — a failed dispatch (structured ``error`` results
+  from the engine, e.g. a :class:`~alphafold2_tpu.serve.faults.FaultPlan`
+  injection) is retried once against a *different* (bucket, batch)
+  executable (the next ladder rung) before the error reaches callers.
+
+Observability rides the PR-2 plumbing: ``sched.*`` counters (rejections,
+sheds, deadline misses, cache hits, dedups, retries) share the engine's
+``EventCounters``; queue-depth / time-to-dispatch / dwell stream into
+``observe.Histogram``; dispatches open ``sched.dispatch`` tracer spans.
+``bench.py --mode serve-async`` drives it open-loop with Poisson arrivals.
+
+Scheduling decisions use an injectable ``clock`` (default
+``time.perf_counter``, the engine's queue-wait timebase), and with
+``start=False`` the dispatcher can be pumped inline — the fake-clock tests
+in ``tests/test_scheduler.py`` are fully deterministic.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import threading
+import time
+from typing import Callable, Optional, Union
+
+from alphafold2_tpu.observe import Histogram, Tracer
+from alphafold2_tpu.serve.bucketing import bucket_for
+from alphafold2_tpu.serve.cache import ResultCache
+from alphafold2_tpu.serve.engine import (
+    ServeEngine,
+    ServeRequest,
+    ServeResult,
+    _as_request,
+)
+
+
+class PendingResult:
+    """Caller-side handle for one submitted request.
+
+    ``result(timeout)`` blocks until the request resolves (to an ``ok``
+    result *or* a structured rejection/deadline/error result — the
+    frontend never raises through this) and raises ``TimeoutError`` only
+    if the timeout itself expires."""
+
+    __slots__ = ("request", "_event", "_result")
+
+    def __init__(self, request: ServeRequest):
+        self.request = request
+        self._event = threading.Event()
+        self._result: Optional[ServeResult] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> ServeResult:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request ({self.request.seq[:16]!r}...) not resolved "
+                f"within {timeout}s"
+            )
+        return self._result
+
+    def _resolve(self, result: ServeResult) -> None:
+        self._result = result
+        self._event.set()
+
+
+@dataclasses.dataclass
+class _Pending:
+    """One admitted (leader) request queued for dispatch."""
+
+    req: ServeRequest
+    handle: PendingResult
+    key: tuple
+    bucket: int
+    priority: int
+    enqueued: float  # scheduler-clock timestamp
+    deadline: Optional[float]  # absolute scheduler-clock deadline
+    seq_no: int
+
+    @property
+    def order(self) -> tuple:
+        return (-self.priority, self.seq_no)
+
+
+class AsyncServeFrontend:
+    """Open-loop serving frontend over a :class:`ServeEngine`.
+
+    >>> frontend = AsyncServeFrontend(engine)
+    >>> handle = frontend.submit("MKTAYIAK...", deadline_s=2.0)
+    >>> result = handle.result(timeout=30)   # structured, never raises
+    >>> frontend.close()
+
+    Scheduling knobs come from ``engine.cfg.serve``: ``queue_depth``,
+    ``dwell_ms``, ``default_deadline_s``, ``cache_size``,
+    ``shed_watermark``, ``retry_failed``. ``start=False`` skips the
+    dispatcher thread; tests then call :meth:`pump` inline against an
+    injected ``clock``.
+    """
+
+    def __init__(
+        self,
+        engine: ServeEngine,
+        clock: Optional[Callable[[], float]] = None,
+        tracer: Optional[Tracer] = None,
+        start: bool = True,
+    ):
+        scfg = engine.cfg.serve
+        self.engine = engine
+        self.counters = engine.counters
+        self.tracer = tracer if tracer is not None else engine.tracer
+        self._clock = clock if clock is not None else time.perf_counter
+        self.queue_depth = max(1, int(scfg.queue_depth))
+        self.dwell_s = max(0.0, float(scfg.dwell_ms) / 1e3)
+        self.default_deadline_s = float(scfg.default_deadline_s or 0.0)
+        self.shed_watermark = float(scfg.shed_watermark)
+        self.retry_failed = bool(scfg.retry_failed)
+        self.cache = ResultCache(scfg.cache_size)
+        self.histograms = {
+            "queue_depth": Histogram(),
+            "time_to_dispatch_s": Histogram(),
+            "dwell_s": Histogram(),
+        }
+        self._lock = threading.Condition()
+        self._queues: dict = {}  # bucket -> list[_Pending], priority-sorted
+        self._depth = 0
+        self._seq_no = 0
+        self._ema_dispatch_s: Optional[float] = None
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="af2-serve-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop the dispatcher and resolve anything still queued as
+        ``rejected`` (reason "frontend closed") — callers never hang on a
+        handle whose dispatcher is gone."""
+        with self._lock:
+            self._stop = True
+            self._lock.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        leftovers = []
+        with self._lock:
+            for q in self._queues.values():
+                leftovers.extend(q)
+                q.clear()
+            self._depth = 0
+        for p in leftovers:
+            self._resolve_leader(
+                p,
+                ServeResult(
+                    seq=p.req.seq, bucket=p.bucket, status="rejected",
+                    error="frontend closed",
+                ),
+                cache_ok=False,
+            )
+
+    def __enter__(self) -> "AsyncServeFrontend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return self._depth
+
+    def stats(self) -> dict:
+        return self.counters.snapshot()
+
+    def histogram_snapshots(self, unit_scale: float = 1.0) -> dict:
+        return {
+            name: h.snapshot(
+                unit_scale=unit_scale if name.endswith("_s") else 1.0,
+                digits=4,
+            )
+            for name, h in self.histograms.items()
+        }
+
+    # --------------------------------------------------------------- submit
+
+    def submit(
+        self,
+        request: Union[str, ServeRequest],
+        priority: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> PendingResult:
+        """Admit (or structurally reject) one request; never blocks on the
+        device, never raises for a servable-or-not decision."""
+        req = _as_request(request)
+        now = self._clock()
+        if priority is None:
+            priority = req.priority
+        if deadline_s is None:
+            deadline_s = (
+                req.deadline_s if req.deadline_s is not None
+                else (self.default_deadline_s or None)
+            )
+        req = dataclasses.replace(
+            req, arrival_s=now, priority=priority, deadline_s=deadline_s
+        )
+        handle = PendingResult(req)
+        self.counters.bump("sched.submitted")
+
+        try:
+            if not req.seq:
+                raise ValueError("empty sequence")
+            bucket = bucket_for(len(req.seq), self.engine.buckets)
+        except ValueError as e:
+            handle._resolve(ServeResult(
+                seq=req.seq, bucket=0, status="rejected",
+                error=f"unservable request: {e}",
+            ))
+            self.counters.bump("sched.rejected")
+            self.tracer.instant("sched.reject", reason="unservable")
+            return handle
+
+        key = (req.seq, req.seed)
+        status, payload = self.cache.lookup_or_claim(
+            key, follower_ctx=(handle, now)
+        )
+        if status == "hit":
+            self.counters.bump("sched.cache_hits")
+            handle._resolve(self._shared_result(payload, now))
+            return handle
+        if status == "follower":
+            # rides the in-flight leader's dispatch; no queue slot consumed
+            self.counters.bump("sched.inflight_dedup")
+            return handle
+
+        # leader: admission control under the scheduler lock
+        with self._lock:
+            rejected = None
+            if self._depth >= self.queue_depth:
+                rejected = ("queue full", "sched.rejected")
+            elif (
+                self.shed_watermark > 0
+                and self._depth + 1 > self.shed_watermark * self.queue_depth
+                and priority <= 0
+            ):
+                rejected = ("load shed (queue past watermark)", "sched.shed")
+            if rejected is None:
+                deadline = now + deadline_s if deadline_s else None
+                pending = _Pending(
+                    req=req, handle=handle, key=key, bucket=bucket,
+                    priority=priority, enqueued=now, deadline=deadline,
+                    seq_no=self._seq_no,
+                )
+                self._seq_no += 1
+                q = self._queues.setdefault(bucket, [])
+                bisect.insort(q, pending, key=lambda p: p.order)
+                self._depth += 1
+                self.counters.bump("sched.admitted")
+                self.histograms["queue_depth"].observe(self._depth)
+                self._lock.notify_all()
+                return handle
+            reason, counter = rejected
+            retry_after = self._retry_after_locked()
+        # rejection resolves outside the lock (cache fulfill + callbacks)
+        self.counters.bump("sched.rejected")
+        if counter == "sched.shed":
+            self.counters.bump("sched.shed")
+        self.tracer.instant("sched.reject", reason=reason, bucket=bucket)
+        self._resolve_leader(
+            _Pending(
+                req=req, handle=handle, key=key, bucket=bucket,
+                priority=priority, enqueued=now, deadline=None, seq_no=-1,
+            ),
+            ServeResult(
+                seq=req.seq, bucket=bucket, status="rejected", error=reason,
+                retry_after_s=retry_after,
+            ),
+            cache_ok=False,
+        )
+        return handle
+
+    def _retry_after_locked(self) -> float:
+        """Backoff hint: roughly how long until the queue drains a batch's
+        worth of slack, from the dispatch-duration EMA (or the dwell window
+        before any dispatch has been measured)."""
+        per_batch = (
+            self._ema_dispatch_s
+            if self._ema_dispatch_s is not None
+            else max(self.dwell_s, 0.05)
+        )
+        batches_ahead = self._depth // self.engine.max_batch + 1
+        return round(batches_ahead * per_batch, 4)
+
+    def _shared_result(self, result: ServeResult, submit_ts: float) -> (
+        ServeResult
+    ):
+        """A cached/deduped caller's view of a shared result: identical
+        arrays (byte-for-byte — same objects), per-caller latency."""
+        wait = max(0.0, self._clock() - submit_ts)
+        return dataclasses.replace(
+            result, cache_hit=True, latency_s=wait, queue_wait_s=wait,
+        )
+
+    # ------------------------------------------------------------- dispatch
+
+    def pump(self) -> int:
+        """One scheduling pass: expire deadlines, form ripe batches, and
+        dispatch them. Returns the number of dispatches executed. The
+        dispatcher thread calls this in a loop; tests with ``start=False``
+        call it inline for deterministic fake-clock scheduling."""
+        now = self._clock()
+        expired: list = []
+        plans: list = []
+        with self._lock:
+            for bucket in sorted(self._queues):
+                q = self._queues[bucket]
+                keep = []
+                dead = []
+                for p in q:
+                    if p.deadline is not None and p.deadline <= now:
+                        dead.append(p)
+                    else:
+                        keep.append(p)
+                if dead:
+                    q[:] = keep
+                    self._depth -= len(dead)
+                    expired.extend(dead)
+                while q:
+                    ripe = len(q) >= self.engine.max_batch or (
+                        now - min(p.enqueued for p in q) >= self.dwell_s
+                    )
+                    if not ripe:
+                        break
+                    take = q[: self.engine.max_batch]
+                    del q[: len(take)]
+                    self._depth -= len(take)
+                    plans.append((bucket, take))
+        for p in expired:
+            self.counters.bump("sched.deadline_miss")
+            self.tracer.instant("sched.deadline_miss", bucket=p.bucket)
+            self._resolve_leader(
+                p,
+                ServeResult(
+                    seq=p.req.seq, bucket=p.bucket,
+                    status="deadline_exceeded",
+                    error=(
+                        f"deadline ({p.req.deadline_s}s) passed after "
+                        f"{now - p.enqueued:.4g}s in queue"
+                    ),
+                    latency_s=max(0.0, now - p.enqueued),
+                    queue_wait_s=max(0.0, now - p.enqueued),
+                ),
+                cache_ok=False,
+            )
+        for bucket, batch in plans:
+            self._execute(bucket, batch, now)
+        return len(plans)
+
+    def _execute(self, bucket: int, pendings: list, formed_at: float) -> None:
+        self.histograms["dwell_s"].observe(
+            max(0.0, formed_at - min(p.enqueued for p in pendings))
+        )
+        for p in pendings:
+            self.histograms["time_to_dispatch_s"].observe(
+                max(0.0, formed_at - p.enqueued)
+            )
+        reqs = [p.req for p in pendings]
+        t0 = self._clock()
+        with self.tracer.span("sched.dispatch", bucket=bucket, n=len(reqs)):
+            results = self.engine.dispatch_batch(bucket, reqs)
+        dt = max(0.0, self._clock() - t0)
+        self._ema_dispatch_s = (
+            dt if self._ema_dispatch_s is None
+            else 0.8 * self._ema_dispatch_s + 0.2 * dt
+        )
+
+        failed = [i for i, r in enumerate(results) if r.status == "error"]
+        if failed and self.retry_failed:
+            # retry once against a DIFFERENT executable: the next ladder
+            # rung when one exists (a fresh (bucket, batch) shape excludes
+            # whatever poisoned the first), else the same rung again
+            retry_at = self.engine.retry_bucket(bucket) or bucket
+            self.counters.bump("sched.retries", len(failed))
+            with self.tracer.span(
+                "sched.retry", bucket=retry_at, failed_bucket=bucket,
+                n=len(failed),
+            ):
+                retried = self.engine.dispatch_batch(
+                    retry_at, [reqs[i] for i in failed]
+                )
+            for i, rr in zip(failed, retried):
+                results[i] = dataclasses.replace(rr, retried=True)
+
+        self.counters.bump("sched.dispatches")
+        self.counters.bump("sched.batched_requests", len(pendings))
+        for p, res in zip(pendings, results):
+            self._resolve_leader(p, res, cache_ok=res.status == "ok")
+
+    def _resolve_leader(
+        self, pending: _Pending, result: ServeResult, cache_ok: bool
+    ) -> None:
+        """Resolve a leader's handle and fan the result out to every
+        follower deduped onto its key (sharing failures too — one dispatch,
+        one outcome). Only ok results enter the LRU."""
+        pending.handle._resolve(result)
+        for handle, submit_ts in self.cache.fulfill(
+            pending.key, result, cache=cache_ok
+        ):
+            handle._resolve(self._shared_result(result, submit_ts))
+
+    # --------------------------------------------------------------- thread
+
+    def _next_wakeup_locked(self, now: float) -> Optional[float]:
+        """Seconds until the next dwell or deadline expiry (0 = a batch is
+        already ripe, None = queue empty: wait for a submit)."""
+        horizon = None
+        for q in self._queues.values():
+            if not q:
+                continue
+            if len(q) >= self.engine.max_batch:
+                return 0.0
+            oldest = min(p.enqueued for p in q)
+            times = [oldest + self.dwell_s]
+            times.extend(p.deadline for p in q if p.deadline is not None)
+            t = min(times)
+            horizon = t if horizon is None else min(horizon, t)
+        if horizon is None:
+            return None
+        return max(0.0, horizon - now)
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                timeout = self._next_wakeup_locked(self._clock())
+                if timeout is None:
+                    self._lock.wait(timeout=1.0)
+                elif timeout > 0:
+                    self._lock.wait(timeout=timeout)
+                if self._stop:
+                    return
+            self.pump()

@@ -673,9 +673,266 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
     return record
 
 
+# ------------------------------------------------------------ serve-async ---
+
+
+def _serve_async_sizes() -> dict:
+    """The open-loop serve-async flagship; CPU-mesh sized (tiny trunk,
+    short buckets) so CI runners and tier-1 hosts produce comparable
+    records against the committed ``bench_serve_async_baseline.json``.
+    AF2TPU_SERVE_ASYNC_* env knobs rescale it for TPU sessions — any of
+    them set marks the record non-flagship (never baseline-compared)."""
+    buckets = tuple(
+        int(v) for v in os.environ.get(
+            "AF2TPU_SERVE_ASYNC_BUCKETS", "12,16,24"
+        ).split(",") if v
+    )
+    return {
+        "buckets": buckets,
+        "max_batch": _env_int("AF2TPU_SERVE_ASYNC_MAX_BATCH", 4),
+        "requests": _env_int("AF2TPU_SERVE_ASYNC_REQUESTS", 50),
+        "rate": float(os.environ.get("AF2TPU_SERVE_ASYNC_RATE", 8.0)),
+        "dup_fraction": 0.2,  # workload definition: repeat-sequence share
+        "dim": _env_int("AF2TPU_SERVE_ASYNC_DIM", 32),
+        "depth": _env_int("AF2TPU_SERVE_ASYNC_DEPTH", 1),
+        "heads": _env_int("AF2TPU_SERVE_ASYNC_HEADS", 2),
+        "dim_head": _env_int("AF2TPU_SERVE_ASYNC_DIM_HEAD", 16),
+        "msa_depth": _env_int("AF2TPU_SERVE_ASYNC_MSA_DEPTH", 2),
+        "mds_iters": _env_int("AF2TPU_SERVE_ASYNC_MDS_ITERS", 20),
+        "dwell_ms": float(os.environ.get("AF2TPU_SERVE_ASYNC_DWELL_MS", 30.0)),
+        "queue_depth": _env_int("AF2TPU_SERVE_ASYNC_QUEUE_DEPTH", 16),
+        "deadline_s": float(
+            os.environ.get("AF2TPU_SERVE_ASYNC_DEADLINE_S", 30.0)
+        ),
+        "cache_size": _env_int("AF2TPU_SERVE_ASYNC_CACHE", 64),
+        "seed": _env_int("AF2TPU_SERVE_ASYNC_SEED", 0),
+    }
+
+
+def _serve_async_metric(s: dict) -> str:
+    return (
+        f"serve-async residues/sec buckets={','.join(map(str, s['buckets']))} "
+        f"max_batch={s['max_batch']} requests={s['requests']} "
+        f"rate={s['rate']:g}/s dup={s['dup_fraction']:g} dim={s['dim']} "
+        f"depth={s['depth']} msa_depth={s['msa_depth']} "
+        f"mds_iters={s['mds_iters']} dwell_ms={s['dwell_ms']:g} "
+        f"queue={s['queue_depth']} deadline_s={s['deadline_s']:g}"
+    )
+
+
+def bench_serve_async(emit: bool = True, tracer: Tracer | None = None) -> dict:
+    """Open-loop latency/goodput bench on the async serving frontend.
+
+    A seeded Poisson arrival process (exponential inter-arrival gaps at
+    ``rate`` req/s, ~20% repeat sequences) submits requests to an
+    ``AsyncServeFrontend`` on their own schedule — the caller does NOT
+    wait for one request before offering the next, so queueing, admission
+    control, dwell-vs-fill batching, dedup and deadlines are all actually
+    exercised. The record carries p50/p95/p99 end-to-end latency over
+    successful requests, goodput (ok residues/sec and ok requests/sec over
+    the whole open-loop window), the rejection rate, and the structured
+    failure counts (deadline misses, cache hits, in-flight dedups,
+    retries, dispatch errors). ``AF2TPU_SERVE_ASYNC_FAULT`` (e.g.
+    ``"dispatch=2,times=1"``) injects a FaultPlan for degradation drills —
+    like every AF2TPU_SERVE_* knob it marks the record non-flagship."""
+    import numpy as np
+
+    from alphafold2_tpu.config import (
+        Config, DataConfig, ModelConfig, ServeConfig,
+    )
+    from alphafold2_tpu.observe import Histogram
+    from alphafold2_tpu.serve import (
+        AsyncServeFrontend, FaultPlan, ServeEngine, ServeRequest,
+    )
+
+    owns_tracer = tracer is None
+    tracer = tracer if tracer is not None else _tracer()
+    s = _serve_async_sizes()
+    with _bench_stage(tracer, "serve_async:backend_init"):
+        cfg = Config(
+            model=ModelConfig(
+                dim=s["dim"], depth=s["depth"], heads=s["heads"],
+                dim_head=s["dim_head"], max_seq_len=3 * s["buckets"][-1],
+                bfloat16=jax.devices()[0].platform != "cpu",
+            ),
+            data=DataConfig(msa_depth=s["msa_depth"]),
+            serve=ServeConfig(
+                buckets=s["buckets"], max_batch=s["max_batch"],
+                mds_iters=s["mds_iters"], dwell_ms=s["dwell_ms"],
+                queue_depth=s["queue_depth"],
+                default_deadline_s=s["deadline_s"],
+                cache_size=s["cache_size"],
+            ),
+        )
+        faults = FaultPlan.from_spec(
+            os.environ.get("AF2TPU_SERVE_ASYNC_FAULT")
+        )
+        engine = ServeEngine(cfg, tracer=tracer, faults=faults)
+
+    # deterministic open-loop workload: Poisson arrivals, mixed lengths,
+    # ~dup_fraction repeats of earlier (seq, seed) pairs (cache/dedup food)
+    rng = np.random.default_rng(s["seed"])
+    lo = max(4, s["buckets"][0] // 2)
+    alpha = "ACDEFGHIKLMNPQRSTVWY"
+    reqs: list = []
+    for i in range(s["requests"]):
+        if reqs and rng.random() < s["dup_fraction"]:
+            reqs.append(reqs[rng.integers(0, len(reqs))])
+        else:
+            n = int(rng.integers(lo, s["buckets"][-1] + 1))
+            reqs.append(ServeRequest(
+                seq="".join(rng.choice(list(alpha), size=n)), seed=i
+            ))
+    gaps = rng.exponential(1.0 / s["rate"], size=s["requests"])
+
+    with _bench_stage(tracer, "serve_async:trace_compile"):
+        t0 = time.perf_counter()
+        engine.warmup()  # one executable per ladder rung, counted
+        compile_s = time.perf_counter() - t0
+
+    if (
+        os.environ.get("AF2TPU_BENCH_CLOCK_CHECK", "1") != "0"
+        and jax.devices()[0].platform != "cpu"
+        and _CLOCK["probe"] is None
+    ):
+        with _bench_stage(tracer, "serve_async:clock_probe"):
+            _CLOCK["probe"] = _clock_probe()
+
+    frontend = AsyncServeFrontend(engine, tracer=tracer)
+    with _bench_stage(tracer, "serve_async:timed_run"):
+        t0 = time.perf_counter()
+        handles = []
+        due = t0
+        for req, gap in zip(reqs, gaps):
+            due += gap
+            delay = due - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            handles.append(frontend.submit(req))
+        results = [h.result(timeout=600) for h in handles]
+        wall = time.perf_counter() - t0
+    frontend.close()
+    _PHASE["name"] = "serve_async:record"
+
+    ok = [r for r in results if r.status == "ok"]
+    rejected = sum(1 for r in results if r.status == "rejected")
+    deadline_missed = sum(
+        1 for r in results if r.status == "deadline_exceeded"
+    )
+    errors = sum(1 for r in results if r.status == "error")
+    lat = Histogram()
+    for r in ok:
+        lat.observe(r.latency_s)
+    lat_ms = lat.snapshot(unit_scale=1e3, digits=4) if ok else {"count": 0}
+    stats = frontend.stats()
+    hists = {
+        (n[:-2] + "_ms" if n.endswith("_s") else n): snap
+        for n, snap in {
+            **engine.histogram_snapshots(unit_scale=1e3),
+            **frontend.histogram_snapshots(unit_scale=1e3),
+        }.items()
+    }
+    hists["latency_e2e_ms"] = lat_ms
+
+    record = {
+        "metric": _serve_async_metric(s),
+        "value": round(sum(len(r.seq) for r in ok) / wall, 1),
+        "unit": "residues/sec",
+        "mode": "serve-async",
+        # end-to-end (submit -> resolve) latency over successful requests
+        "p50_ms": round(lat_ms.get("p50", 0.0), 1),
+        "p95_ms": round(lat_ms.get("p95", 0.0), 1),
+        "p99_ms": round(lat_ms.get("p99", 0.0), 1),
+        "goodput_rps": round(len(ok) / wall, 3),
+        "rejection_rate": round(rejected / max(1, len(results)), 4),
+        "requests": len(results),
+        "completed": len(ok),
+        "rejected": rejected,
+        "deadline_misses": deadline_missed,
+        "dispatch_error_results": errors,
+        "cache_hits": stats.get("sched.cache_hits", 0),
+        "inflight_dedup": stats.get("sched.inflight_dedup", 0),
+        "retries": stats.get("sched.retries", 0),
+        "dispatches": stats.get("sched.dispatches", 0),
+        "compiles": stats.get("serve.compiles", 0),
+        "compile_s": round(compile_s, 1),
+        "histograms": hists,
+        "compile_records": engine.compile_records,
+        "device": jax.devices()[0].device_kind,
+    }
+    if engine.executed_flops:
+        record["flops_total"] = engine.executed_flops
+        from alphafold2_tpu.observe.flops import mfu as _mfu
+
+        async_mfu = _mfu(engine.executed_flops, wall)
+        if async_mfu is not None:
+            record["mfu"] = round(async_mfu, 4)
+    spans = tracer.span_totals()
+    if spans:
+        record["spans"] = spans
+    hbm_peak = engine.memory.peak_bytes()
+    if hbm_peak is not None:
+        record["hbm_peak_bytes"] = hbm_peak
+    if _CLOCK["probe"] is not None:
+        record["clock_probe"] = _CLOCK["probe"]
+        if not _CLOCK["probe"]["ok"]:
+            record["clock_suspect"] = True
+
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "bench_serve_async_baseline.json",
+    )
+    vs, compared = 1.0, False
+    if (
+        os.path.exists(baseline_path)
+        and not serve_config_overridden()
+        and not record.get("clock_suspect")
+    ):
+        with open(baseline_path) as f:
+            base = json.load(f)
+        if (
+            base.get("value")
+            and base.get("metric") == record["metric"]
+            and base.get("device") == record["device"]
+        ):
+            vs = record["value"] / base["value"]
+            compared = True
+    record["vs_baseline"] = round(vs, 3)
+    record["vs_baseline_valid"] = compared and not record.get("clock_suspect")
+    if record.get("clock_suspect"):
+        record["vs_baseline"] = 0.0
+
+    if (
+        os.environ.get("AF2TPU_SERVE_RECORD_BASELINE") == "1"
+        and not serve_config_overridden()
+        and not record.get("clock_suspect")
+    ):
+        with open(baseline_path, "w") as f:
+            json.dump(record, f, indent=2)
+        print(
+            f"recorded serve-async baseline -> {baseline_path}",
+            file=sys.stderr,
+        )
+
+    logger = _metrics_logger()
+    if logger is not None:
+        logger.log(0, stats)
+        logger.log(0, {
+            k: v for k, v in record.items()
+            if isinstance(v, (int, float, str, bool))
+        })
+        MemorySampler().log_to(logger)
+    if owns_tracer:
+        tracer.close()
+    if emit:
+        _emit(record)
+    return record
+
+
 def bench_mode(argv=None) -> str:
-    """The bench mode: 'train' (default flagship step bench) or 'serve'.
-    Spelled ``--mode serve`` / ``--mode=serve`` or AF2TPU_BENCH_MODE."""
+    """The bench mode: 'train' (default flagship step bench), 'serve'
+    (closed-loop batched engine) or 'serve-async' (open-loop frontend).
+    Spelled ``--mode serve`` / ``--mode=serve-async`` or AF2TPU_BENCH_MODE."""
     args = sys.argv[1:] if argv is None else argv
     for i, a in enumerate(args):
         if a == "--mode" and i + 1 < len(args):
@@ -869,13 +1126,14 @@ if __name__ == "__main__":
             on_dead=_on_liveness_dead,
         ).start()
 
-    if bench_mode() == "serve":
-        # the serve bench runs wherever the engine runs (the CPU mesh
+    _mode = bench_mode()
+    if _mode in ("serve", "serve-async"):
+        # the serve benches run wherever the engine runs (the CPU mesh
         # included — that is the point: valid perf numbers without the
         # tunnel); no preflight, no first-light, same watchdog + one-JSON-
         # line contract as the train bench
         try:
-            bench_serve()
+            (bench_serve if _mode == "serve" else bench_serve_async)()
             sys.exit(0)
         except Exception as e:
             _emit_failure(f"{type(e).__name__}: {e}")

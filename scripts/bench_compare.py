@@ -4,11 +4,14 @@
     python scripts/bench_compare.py CURRENT [--baseline PATH]
         [--threshold metric=tol | metric=direction:tol ...]
 
-``CURRENT`` is a JSON record as emitted by ``bench.py`` (either mode) —
+``CURRENT`` is a JSON record as emitted by ``bench.py`` (any mode) —
 a file path, or ``-`` to read the record from stdin (so the bench can pipe
 straight in). ``--baseline`` defaults to the committed baseline for the
 record's mode: ``bench_serve_baseline.json`` for serve records,
-``bench_baseline.json`` otherwise.
+``bench_serve_async_baseline.json`` for serve-async records,
+``bench_baseline.json`` otherwise. The per-metric threshold table is also
+mode-keyed (``observe.regress.thresholds_for``): serve-async records gate
+goodput and rejection rate beside the latency percentiles.
 
 Prints ONE JSON line: ``{"verdict": "pass"|"regress"|"no-data", ...}`` with
 per-metric comparisons (ratio vs threshold) or a no-data reason. The
@@ -30,7 +33,11 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from alphafold2_tpu.observe.regress import compare, parse_threshold_overrides
+from alphafold2_tpu.observe.regress import (
+    compare,
+    parse_threshold_overrides,
+    thresholds_for,
+)
 
 
 def _load_record(path: str) -> dict:
@@ -53,11 +60,10 @@ def _load_record(path: str) -> dict:
 
 
 def default_baseline_path(record: dict) -> str:
-    name = (
-        "bench_serve_baseline.json"
-        if record.get("mode") == "serve"
-        else "bench_baseline.json"
-    )
+    name = {
+        "serve": "bench_serve_baseline.json",
+        "serve-async": "bench_serve_async_baseline.json",
+    }.get(record.get("mode"), "bench_baseline.json")
     return os.path.join(REPO, name)
 
 
@@ -93,7 +99,11 @@ def main(argv=None) -> int:
         return 2
 
     try:
-        thresholds = parse_threshold_overrides(args.threshold)
+        # base table keyed by the record's shape (serve-async records gate
+        # on goodput/rejection-rate, not just the train/serve metric set)
+        thresholds = parse_threshold_overrides(
+            args.threshold, base=thresholds_for(current)
+        )
     except ValueError as e:
         print(f"ERROR: {e}", file=sys.stderr)
         return 2

@@ -154,6 +154,49 @@ def report_train(records: list) -> None:
               f"({len(t.get('nonfinite', []))} tensors non-finite)")
 
 
+def report_scheduler(latest: dict) -> None:
+    """Async-frontend section of a metrics.jsonl: admission-control and
+    queue outcomes from the ``sched.*`` counters the scheduler shares with
+    the engine (serve/scheduler.py), plus the open-loop latency/queue
+    summary when a serve-async bench record rode the same file. The
+    queue-depth / time-to-dispatch / dwell distributions live in the
+    bench record's ``histograms``; the trace file's ``sched.dispatch`` /
+    ``sched.retry`` spans appear in the standard span table."""
+    if not any(k.startswith("sched.") for k in latest):
+        return
+    submitted = latest.get("sched.submitted", 0)
+    rejected = latest.get("sched.rejected", 0)
+    print(f"-- async scheduler ({int(submitted)} submitted) --")
+    print(f"  admitted:       {int(latest.get('sched.admitted', 0))}")
+    shed = latest.get("sched.shed", 0)
+    rate = rejected / submitted if submitted else 0.0
+    print(f"  rejected:       {int(rejected)}  ({rate:.1%}; "
+          f"{int(shed)} load-shed past the watermark)")
+    print(f"  deadline miss:  {int(latest.get('sched.deadline_miss', 0))}")
+    hits = latest.get("sched.cache_hits", 0)
+    dedup = latest.get("sched.inflight_dedup", 0)
+    saved = (hits + dedup) / submitted if submitted else 0.0
+    print(f"  result cache:   {int(hits)} hits + {int(dedup)} in-flight "
+          f"dedups ({saved:.1%} of submissions never dispatched)")
+    retries = latest.get("sched.retries", 0)
+    errors = latest.get("serve.dispatch_errors", 0)
+    if retries or errors:
+        print(f"  faults:         {int(errors)} dispatch errors, "
+              f"{int(retries)} requests retried on another executable")
+    dispatches = latest.get("sched.dispatches", 0)
+    batched = latest.get("sched.batched_requests", 0)
+    if dispatches:
+        print(f"  dispatches:     {int(dispatches)}  "
+              f"(mean batch {batched / dispatches:.2f} requests)")
+    for key, label in (("p50_ms", "p50"), ("p95_ms", "p95"),
+                       ("p99_ms", "p99")):
+        if key not in latest:
+            break
+    else:
+        print(f"  e2e latency:    p50 {latest['p50_ms']:.1f}ms  "
+              f"p95 {latest['p95_ms']:.1f}ms  p99 {latest['p99_ms']:.1f}ms")
+
+
 def report_metrics(path: str) -> int:
     records = []
     with open(path) as f:
@@ -174,6 +217,7 @@ def report_metrics(path: str) -> int:
             print(f"  {k} = {latest[k]}")
 
     report_train(records)
+    report_scheduler(latest)
 
     compiles = latest.get("serve.compiles", latest.get("compiles"))
     hits = latest.get("serve.cache_hits", latest.get("cache_hits"))

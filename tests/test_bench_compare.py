@@ -137,9 +137,64 @@ def test_cli_default_baseline_routing(bench_compare):
     assert bench_compare.default_baseline_path({"mode": "serve"}).endswith(
         "bench_serve_baseline.json"
     )
+    assert bench_compare.default_baseline_path(
+        {"mode": "serve-async"}
+    ).endswith("bench_serve_async_baseline.json")
     assert bench_compare.default_baseline_path({}).endswith(
         "bench_baseline.json"
     )
+
+
+# -------------------------------------------------- serve-async thresholds
+
+ASYNC_BASE = {
+    "metric": "serve-async residues/sec tiny", "device": "cpu",
+    "mode": "serve-async", "value": 100.0, "goodput_rps": 8.0,
+    "p50_ms": 50.0, "p95_ms": 100.0, "p99_ms": 150.0,
+    "rejection_rate": 0.05,
+}
+
+
+def test_serve_async_threshold_selection():
+    """The gate picks the serve-async direction table by record shape, so
+    open-loop records get real per-metric verdicts, not no-data."""
+    assert regress.thresholds_for(ASYNC_BASE) is regress.SERVE_ASYNC_THRESHOLDS
+    assert regress.thresholds_for(BASE) is regress.DEFAULT_THRESHOLDS
+    assert regress.thresholds_for(None) is regress.DEFAULT_THRESHOLDS
+    assert {"goodput_rps", "rejection_rate", "value", "p99_ms"} <= set(
+        regress.SERVE_ASYNC_THRESHOLDS
+    )
+
+
+def test_compare_serve_async_directions():
+    thr = regress.SERVE_ASYNC_THRESHOLDS
+    v = regress.compare(ASYNC_BASE, ASYNC_BASE, thr)
+    assert v["verdict"] == "pass"
+    assert {"goodput_rps", "rejection_rate"} <= {
+        c["name"] for c in v["comparisons"]
+    }
+    # goodput collapse regresses (higher-is-better)
+    v = regress.compare({**ASYNC_BASE, "goodput_rps": 1.0}, ASYNC_BASE, thr)
+    assert v["verdict"] == "regress" and "goodput_rps" in v["regressions"]
+    # rejection storm regresses (lower-is-better)
+    v = regress.compare({**ASYNC_BASE, "rejection_rate": 0.5}, ASYNC_BASE, thr)
+    assert v["verdict"] == "regress" and "rejection_rate" in v["regressions"]
+    # a zero-rejection baseline cannot gate the ratio (explicitly ok)
+    v = regress.compare(
+        {**ASYNC_BASE, "rejection_rate": 0.5},
+        {**ASYNC_BASE, "rejection_rate": 0.0}, thr,
+    )
+    assert v["verdict"] == "pass"
+
+
+def test_cli_uses_serve_async_thresholds(bench_compare, tmp_path, capsys):
+    """p95 2.5x worse: within the generous default-table tolerance? No —
+    and for serve-async shapes the CLI must gate goodput too."""
+    cur = _write(tmp_path, "cur.json", {**ASYNC_BASE, "goodput_rps": 2.0})
+    base = _write(tmp_path, "base.json", ASYNC_BASE)
+    assert bench_compare.main([cur, "--baseline", base]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["verdict"] == "regress" and "goodput_rps" in out["regressions"]
 
 
 def test_cli_threshold_override(bench_compare, tmp_path, capsys):

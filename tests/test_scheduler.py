@@ -1,0 +1,426 @@
+"""Async serving frontend tests (serve/scheduler.py + cache.py + faults.py).
+
+The scheduling logic is tested deterministically: a fake clock plus
+``start=False`` (the dispatcher is pumped inline, no thread) pins dwell
+expiry vs batch fill, deadline misses, bounded-queue rejection, load
+shedding and dedup without a single sleep. The real-engine tests then
+prove the integration contracts: cached results byte-identical to direct
+``predict_many`` output, and an injected dispatch failure yielding
+retried-success instead of an exception to the caller."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    ServeConfig,
+)
+from alphafold2_tpu.observe import EventCounters, Tracer
+from alphafold2_tpu.serve import (
+    AsyncServeFrontend,
+    FaultPlan,
+    InjectedFault,
+    ResultCache,
+    ServeEngine,
+    ServeRequest,
+    ServeResult,
+)
+
+
+def _cfg(buckets=(8, 16), max_batch=2, **serve_kw):
+    serve_kw.setdefault("mds_iters", 10)
+    return Config(
+        model=ModelConfig(dim=32, depth=1, heads=2, dim_head=16,
+                          max_seq_len=3 * max(buckets), bfloat16=False),
+        data=DataConfig(msa_depth=2),
+        serve=ServeConfig(buckets=buckets, max_batch=max_batch, **serve_kw),
+    )
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+class FakeEngine:
+    """Engine stand-in for deterministic scheduler tests: records every
+    dispatch, optionally fails the first N, never touches jax."""
+
+    def __init__(self, cfg, fail_first=0):
+        self.cfg = cfg
+        self.buckets = cfg.serve.buckets
+        self.max_batch = cfg.serve.max_batch
+        self.counters = EventCounters()
+        self.tracer = Tracer(enabled=False)
+        self.dispatched = []  # (bucket, [seq, ...]) per dispatch
+        self._fail_remaining = fail_first
+
+    def dispatch_batch(self, bucket, reqs):
+        self.dispatched.append((bucket, [r.seq for r in reqs]))
+        if self._fail_remaining > 0:
+            self._fail_remaining -= 1
+            return [
+                ServeResult(seq=r.seq, bucket=bucket, status="error",
+                            error="InjectedFault: boom")
+                for r in reqs
+            ]
+        return [
+            ServeResult(
+                seq=r.seq, bucket=bucket,
+                atom14=np.zeros((len(r.seq), 14, 3), np.float32),
+                latency_s=1e-3,
+            )
+            for r in reqs
+        ]
+
+    def retry_bucket(self, bucket):
+        i = self.buckets.index(bucket)
+        return self.buckets[i + 1] if i + 1 < len(self.buckets) else None
+
+
+def _frontend(fail_first=0, **serve_kw):
+    serve_kw.setdefault("dwell_ms", 50.0)
+    eng = FakeEngine(_cfg(**serve_kw), fail_first=fail_first)
+    clock = FakeClock()
+    fe = AsyncServeFrontend(eng, clock=clock, start=False)
+    return fe, eng, clock
+
+
+# ----------------------------------------------------- dwell vs batch fill
+
+
+def test_full_batch_dispatches_without_dwell():
+    fe, eng, clock = _frontend()
+    h1, h2 = fe.submit("ACDEFG"), fe.submit("MKVLIT")
+    assert fe.pump() == 1  # batch filled to max_batch: no dwell wait
+    assert eng.dispatched == [(8, ["ACDEFG", "MKVLIT"])]
+    assert h1.result(0).ok and h2.result(0).ok
+
+
+def test_partial_batch_waits_for_dwell_then_dispatches():
+    fe, eng, clock = _frontend(dwell_ms=50.0)
+    h = fe.submit("ACDEFG")
+    assert fe.pump() == 0  # under-full and dwell not yet expired
+    assert not h.done()
+    clock.advance(0.049)
+    assert fe.pump() == 0  # still inside the dwell window
+    clock.advance(0.002)
+    assert fe.pump() == 1  # dwell expired: dispatch partial
+    assert eng.dispatched == [(8, ["ACDEFG"])]
+    assert h.result(0).ok
+
+
+def test_buckets_batch_independently():
+    fe, eng, clock = _frontend()
+    fe.submit("ACDEFG")  # bucket 8
+    fe.submit("ACDEFGHKLMNP")  # bucket 16
+    assert fe.pump() == 0  # neither bucket is full
+    clock.advance(0.051)
+    assert fe.pump() == 2  # both dwell-expire into partial dispatches
+    assert sorted(b for b, _ in eng.dispatched) == [8, 16]
+
+
+# ---------------------------------------------------------------- deadline
+
+
+def test_deadline_miss_is_structured_and_never_dispatches():
+    fe, eng, clock = _frontend(dwell_ms=10_000.0)
+    h = fe.submit("ACDEFG", deadline_s=0.2)
+    clock.advance(0.3)
+    assert fe.pump() == 0
+    r = h.result(0)
+    assert r.status == "deadline_exceeded" and not r.ok
+    assert r.atom14 is None and "deadline" in r.error
+    assert r.queue_wait_s == pytest.approx(0.3)
+    assert eng.dispatched == []
+    assert fe.stats()["sched.deadline_miss"] == 1
+
+
+def test_default_deadline_from_config():
+    fe, eng, clock = _frontend(dwell_ms=10_000.0, default_deadline_s=0.1)
+    h = fe.submit("ACDEFG")
+    clock.advance(0.2)
+    fe.pump()
+    assert h.result(0).status == "deadline_exceeded"
+
+
+def test_deadline_not_missed_when_dispatched_in_time():
+    fe, eng, clock = _frontend()
+    h = fe.submit("ACDEFG", deadline_s=1.0)
+    fe.submit("MKVLIT")
+    assert fe.pump() == 1
+    assert h.result(0).ok
+
+
+# ------------------------------------------------------- admission control
+
+
+def test_bounded_queue_rejects_with_retry_after():
+    fe, eng, clock = _frontend(
+        queue_depth=2, dwell_ms=10_000.0, shed_watermark=0.0
+    )
+    handles = [fe.submit(s, priority=1)
+               for s in ("ACDE", "MKVL", "GHKL")]
+    assert handles[0].done() is False and handles[1].done() is False
+    r = handles[2].result(0)  # third arrival: queue full, never queued
+    assert r.status == "rejected" and "queue full" in r.error
+    assert r.retry_after_s is not None and r.retry_after_s > 0
+    assert eng.dispatched == []
+    s = fe.stats()
+    assert s["sched.rejected"] == 1 and s["sched.admitted"] == 2
+
+
+def test_load_shedding_at_watermark_spares_high_priority():
+    fe, eng, clock = _frontend(
+        queue_depth=4, dwell_ms=10_000.0, shed_watermark=0.5
+    )
+    assert not fe.submit("ACDE").done()  # depth 1 <= watermark(2)
+    assert not fe.submit("MKVL").done()  # depth 2 == watermark
+    shed = fe.submit("GHKL")  # depth would cross the watermark
+    r = shed.result(0)
+    assert r.status == "rejected" and "shed" in r.error
+    vip = fe.submit("WYTS", priority=1)  # high priority rides through
+    assert not vip.done()
+    s = fe.stats()
+    assert s["sched.shed"] == 1 and s["sched.rejected"] == 1
+    assert s["sched.admitted"] == 3
+
+
+def test_unservable_requests_reject_structurally():
+    fe, eng, clock = _frontend()  # largest bucket 16
+    r = fe.submit("A" * 40).result(0)
+    assert r.status == "rejected" and "unservable" in r.error
+    r = fe.submit("").result(0)
+    assert r.status == "rejected"
+    assert eng.dispatched == []
+
+
+def test_close_resolves_queued_requests():
+    fe, eng, clock = _frontend(dwell_ms=10_000.0)
+    h = fe.submit("ACDEFG")
+    fe.close()
+    r = h.result(0)
+    assert r.status == "rejected" and "closed" in r.error
+
+
+# --------------------------------------------------------- cache and dedup
+
+
+def test_inflight_dedup_shares_one_dispatch():
+    fe, eng, clock = _frontend()
+    h1 = fe.submit(ServeRequest("ACDEFG", seed=7))
+    h2 = fe.submit(ServeRequest("ACDEFG", seed=7))  # identical key: follower
+    assert fe.pump() == 0  # ONE queue entry: batch is not full
+    clock.advance(0.051)
+    assert fe.pump() == 1
+    assert len(eng.dispatched) == 1
+    r1, r2 = h1.result(0), h2.result(0)
+    assert r1.ok and r2.ok
+    assert r2.cache_hit and r2.atom14 is r1.atom14  # the same arrays
+    assert fe.stats()["sched.inflight_dedup"] == 1
+
+
+def test_result_cache_hit_skips_queue_entirely():
+    fe, eng, clock = _frontend(
+        queue_depth=1, dwell_ms=10_000.0, shed_watermark=0.0
+    )
+    h1 = fe.submit(ServeRequest("ACDEFG", seed=7))
+    fe.submit("MKVLIT")  # queue (depth 1) is full: structured rejection
+    clock.advance(11.0)
+    fe.pump()
+    assert h1.result(0).ok
+    # repeat of a completed key resolves instantly — even with the queue
+    # full, admission control never touches a cache hit
+    fe.submit("XXXX")  # occupies the queue again
+    h3 = fe.submit(ServeRequest("ACDEFG", seed=7))
+    r3 = h3.result(0)
+    assert r3.ok and r3.cache_hit
+    assert len(eng.dispatched) == 1
+    assert fe.stats()["sched.cache_hits"] == 1
+
+
+def test_distinct_seeds_do_not_dedup():
+    fe, eng, clock = _frontend()
+    fe.submit(ServeRequest("ACDEFG", seed=1))
+    fe.submit(ServeRequest("ACDEFG", seed=2))
+    assert fe.pump() == 1  # two distinct keys fill the batch
+    assert eng.dispatched == [(8, ["ACDEFG", "ACDEFG"])]
+
+
+def test_result_cache_lru_eviction_and_inflight_table():
+    cache = ResultCache(capacity=2)
+    status, entry = cache.lookup_or_claim("a")
+    assert status == "leader"
+    assert cache.lookup_or_claim("a", follower_ctx="ctx")[0] == "follower"
+    assert cache.fulfill("a", "ra") == ["ctx"]
+    for key, res in (("b", "rb"), ("c", "rc")):
+        assert cache.lookup_or_claim(key)[0] == "leader"
+        cache.fulfill(key, res)
+    assert cache.peek("a") is None  # LRU evicted by b, c
+    assert cache.lookup_or_claim("c")[0] == "hit"
+    # failures must not be cached (cache=False) but still fan out
+    assert cache.lookup_or_claim("d")[0] == "leader"
+    cache.fulfill("d", "err", cache=False)
+    assert cache.peek("d") is None
+    # capacity 0 disables the LRU but dedup still works
+    nocache = ResultCache(capacity=0)
+    assert nocache.lookup_or_claim("x")[0] == "leader"
+    assert nocache.lookup_or_claim("x")[0] == "follower"
+    nocache.fulfill("x", "rx")
+    assert nocache.lookup_or_claim("x")[0] == "leader"
+
+
+# ------------------------------------------------------------ fault + retry
+
+
+def test_injected_failure_is_retried_on_next_rung():
+    fe, eng, clock = _frontend(fail_first=1)
+    h1, h2 = fe.submit("ACDEFG"), fe.submit("MKVLIT")
+    assert fe.pump() == 1
+    # first dispatch at bucket 8 failed; retry ran at rung 16
+    assert [b for b, _ in eng.dispatched] == [8, 16]
+    r1, r2 = h1.result(0), h2.result(0)
+    assert r1.ok and r2.ok
+    assert r1.retried and r2.retried
+    assert fe.stats()["sched.retries"] == 2
+
+
+def test_retry_exhaustion_delivers_structured_error():
+    fe, eng, clock = _frontend(fail_first=2)  # retry fails too
+    h = fe.submit("ACDEFG")
+    fe.submit("MKVLIT")
+    fe.pump()
+    r = h.result(0)
+    assert r.status == "error" and "boom" in r.error
+    assert r.retried  # the delivered result is the retry's
+
+
+def test_retry_disabled_by_config():
+    fe, eng, clock = _frontend(fail_first=1, retry_failed=False)
+    fe.submit("ACDEFG")
+    h = fe.submit("MKVLIT")
+    fe.pump()
+    assert h.result(0).status == "error"
+    assert len(eng.dispatched) == 1
+
+
+def test_fault_plan_matching_and_spec():
+    plan = FaultPlan(fail_dispatch=2, times=1)
+    plan.on_dispatch(1, 8)  # no match
+    with pytest.raises(InjectedFault):
+        plan.on_dispatch(2, 8)
+    plan.on_dispatch(2, 8)  # budget (times=1) exhausted: inert
+    assert plan.fired == [{"dispatch": 2, "bucket": 8}]
+
+    plan = FaultPlan.from_spec("bucket=16,times=2,delay=0,fail=1")
+    assert plan.fail_bucket == 16 and plan.times == 2 and plan.fail
+    delay_only = FaultPlan.from_spec("dispatch=1,fail=0")
+    delay_only.on_dispatch(1, 8)  # delay-only plans never raise
+    assert delay_only.fired
+    assert FaultPlan.from_spec(None) is None
+    assert FaultPlan.from_spec("") is None
+    with pytest.raises(ValueError, match="unknown fault-spec key"):
+        FaultPlan.from_spec("nope=1")
+
+
+# ---------------------------------------------------- real-engine contracts
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return ServeEngine(_cfg())
+
+
+def test_cached_result_byte_identical_to_predict_many(engine):
+    """Acceptance criterion: a frontend-cached result must be
+    byte-identical to an uncached direct predict_many of the same
+    (seq, seed) — caching can never change what a caller receives."""
+    direct = engine.predict_many([ServeRequest("ACDEFG", seed=3)])[0]
+    with AsyncServeFrontend(engine) as fe:
+        first = fe.submit(ServeRequest("ACDEFG", seed=3)).result(120)
+        cached = fe.submit(ServeRequest("ACDEFG", seed=3)).result(10)
+    assert first.ok and cached.ok and cached.cache_hit
+    assert cached.atom14.tobytes() == direct.atom14.tobytes()
+    assert cached.backbone.tobytes() == direct.backbone.tobytes()
+    assert cached.weights.tobytes() == direct.weights.tobytes()
+    assert fe.stats()["sched.cache_hits"] == 1
+
+
+def test_real_engine_fault_retry_success(engine):
+    """One injected dispatch failure yields retried-success for the
+    caller — never an exception."""
+    plan = FaultPlan(fail_bucket=8, times=1)
+    eng = ServeEngine(_cfg(), params=engine.params, faults=plan)
+    with AsyncServeFrontend(eng) as fe:
+        r = fe.submit("ACDEFG").result(180)
+    assert r.ok and r.retried
+    assert r.bucket == 16  # retried on the next rung's executable
+    assert plan.fired == [{"dispatch": 1, "bucket": 8}]
+    s = eng.stats()
+    assert s["serve.dispatch_errors"] == 1 and s["sched.retries"] == 1
+    assert np.all(np.isfinite(r.atom14))
+
+
+def test_threaded_frontend_end_to_end(engine):
+    """Background-dispatcher smoke on the real engine: mixed lengths and
+    duplicates all resolve ok through the live thread."""
+    reqs = ["ACDEFG", "MKVLIT", "ACDEFGHKLMNP", "ACDEFG", "WY"]
+    with AsyncServeFrontend(engine) as fe:
+        handles = [fe.submit(ServeRequest(s, seed=1)) for s in reqs]
+        results = [h.result(180) for h in handles]
+    assert all(r.ok for r in results)
+    for seq, r in zip(reqs, results):
+        assert r.seq == seq and r.atom14.shape == (len(seq), 14, 3)
+    assert fe.histograms["queue_depth"].count >= 1
+
+
+# --------------------------------------------- engine satellites (PR fixes)
+
+
+def test_per_request_arrival_queue_wait(engine):
+    """A request carrying its own arrival stamp gets its own queue-wait;
+    the stream-level fallback keeps working beside it."""
+    import time
+
+    old = ServeRequest("ACDEFG", seed=1,
+                       arrival_s=time.perf_counter() - 5.0)
+    fresh = ServeRequest("MKVLIT", seed=2)
+    r_old, r_fresh = engine.predict_many([old, fresh])
+    assert r_old.queue_wait_s >= 4.9  # honored its own (older) arrival
+    assert r_fresh.queue_wait_s < 2.0  # stream arrival, not the stale one
+    assert r_old.latency_s == pytest.approx(
+        r_old.queue_wait_s + r_old.dispatch_s
+    )
+
+
+def test_dispatch_error_yields_structured_results(engine):
+    """Engine hardening: a mid-dispatch exception becomes per-request
+    error results (no Nones, no raise), and the plan's budget expiry lets
+    the very next call succeed."""
+    plan = FaultPlan(fail_bucket=8, times=1)
+    eng = ServeEngine(_cfg(), params=engine.params, faults=plan)
+    out = eng.predict_many(["ACDEFG", "MK"])
+    assert [r.status for r in out] == ["error", "error"]
+    assert all("InjectedFault" in r.error for r in out)
+    assert all(r.atom14 is None for r in out)
+    assert eng.stats()["serve.dispatch_errors"] == 1
+    ok = eng.predict_many(["ACDEFG"])[0]
+    assert ok.ok and ok.error is None
+
+
+def test_serve_result_dataclass_defaults():
+    r = ServeResult(seq="AC", bucket=8, status="rejected",
+                    error="queue full", retry_after_s=0.5)
+    assert not r.ok and r.atom14 is None and r.retry_after_s == 0.5
+    r2 = dataclasses.replace(r, status="ok")
+    assert r2.ok

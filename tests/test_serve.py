@@ -241,8 +241,10 @@ def test_engine_histograms_and_compile_records():
     res = eng.predict_many(["ACDE", "ACDEF", "ACDEFG", "ACDEFGHKLMNP"])
     h = eng.histograms
     assert h["latency_s"].count == 4  # one observation per request
-    # one per dispatch: 3 reqs in the 8-bucket (one full batch) + 1 in 16
-    assert h["queue_wait_s"].count == 2
+    # queue wait is per REQUEST (each request can carry its own arrival);
+    # dispatch/occupancy stay per dispatch: 3 reqs in the 8-bucket (one
+    # full batch) + 1 in 16
+    assert h["queue_wait_s"].count == 4
     assert h["dispatch_s"].count == 2
     assert h["batch_occupancy"].count == 2
     assert h["pad_ratio"].count == 4
