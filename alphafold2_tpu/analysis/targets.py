@@ -169,8 +169,60 @@ def _build_serve_fwd():
     return fwd, (params, seq, msa, mask, msa_mask)
 
 
+def _build_serve_fwd_grid():
+    """The serve engine's _fwd traced under an active 2D pair-grid mesh —
+    the sharded executable ServeEngine AOT-compiles when constructed with
+    a mesh (serve/engine.py). Auditing it pins the sharded graph: the
+    shard_map axial passes, their all_to_all transposes and the
+    sharding-constraint boundaries are all part of the fingerprint's op
+    mix. The mesh degrades to the devices available (fingerprints are
+    mesh-SIZE independent: op counts recurse into the shard_map body and
+    the input signature uses global shapes), so the audit runs identically
+    on a 1-device laptop, the 8-virtual-device CI mesh, and on-chip."""
+    import jax
+    import jax.numpy as jnp
+
+    from alphafold2_tpu.parallel.grid_parallel import make_grid_mesh
+    from alphafold2_tpu.parallel.sharding import use_mesh
+    from alphafold2_tpu.train.end2end import End2EndModel
+
+    bucket, batch, depth = 8, 2, 2
+    devices = jax.devices()
+    n_col = 2 if len(devices) >= 2 else 1
+    n_row = 2 if len(devices) >= 4 else 1
+    mesh = make_grid_mesh(
+        1, n_row, n_col, devices=devices[: n_row * n_col]
+    )
+    model = End2EndModel(
+        dim=32, depth=1, heads=2, dim_head=16, max_seq_len=3 * bucket,
+        mds_iters=8, mds_per_position_init=True, grid_parallel=True,
+        dtype=jnp.float32,
+    )
+    seq = jnp.zeros((batch, bucket), jnp.int32)
+    msa = jnp.zeros((batch, depth, bucket), jnp.int32)
+    mask = jnp.ones((batch, bucket), bool)
+    msa_mask = jnp.ones((batch, depth, bucket), bool)
+    params = model.init(jax.random.key(0), seq, msa, mask=mask,
+                        msa_mask=msa_mask)
+    mds_key = jax.random.key(0)
+
+    def fwd(params, seq, msa, mask, msa_mask):
+        # the mesh context activates the model's shard_pair constraints
+        # and the shard_map axial passes at trace time, exactly as the
+        # engine's sharded _get_executable does
+        with use_mesh(mesh):
+            out = model.apply(
+                params, seq, msa, mask=mask, msa_mask=msa_mask,
+                mds_key=mds_key, deterministic=True,
+            )
+        return {"refined": out["refined"], "weights": out["weights"]}
+
+    return fwd, (params, seq, msa, mask, msa_mask)
+
+
 def default_targets() -> list:
-    """The audited surface: model forward, train step, serve forward."""
+    """The audited surface: model forward, train step, serve forward
+    (single-device and grid-mesh-sharded)."""
     return [
         TraceTarget(name="model_fwd", build=_build_model_fwd),
         TraceTarget(
@@ -202,6 +254,19 @@ def default_targets() -> list:
                     "coordinate outputs; donation is still wanted so the "
                     "runtime can release request buffers during execution "
                     "on HBM-tight serving (serve/engine.py)"
+                ),
+            },
+        ),
+        TraceTarget(
+            name="serve_fwd_grid",
+            build=_build_serve_fwd_grid,
+            donate_argnums=(1, 2, 3, 4),
+            allow=frozenset({"AF2A104"}),
+            allow_reasons={
+                "AF2A104": (
+                    "same early-free donation intent as serve_fwd: the "
+                    "sharded engine donates the int/bool feature buffers "
+                    "it device_put with explicit shardings"
                 ),
             },
         ),

@@ -98,6 +98,14 @@ class ServeConfig:
     so each bucket compiles exactly one (bucket, max_batch) executable."""
 
     buckets: Tuple[int, ...] = (64, 96, 128, 192, 256)  # residues, ascending
+    # mesh-gated long-chain rungs (e.g. 512,768,1024 — the crop-free
+    # ladder): their O(N^2) pair state only fits per-device memory when
+    # sharded, so ServeEngine REJECTS them without a device mesh and admits
+    # them (appended above ``buckets``) when constructed with one
+    long_buckets: Tuple[int, ...] = ()
+    # requests fused per dispatch on the long-chain rungs (their per-request
+    # memory is what the mesh exists to shard; batch multiplies it back)
+    long_max_batch: int = 1
     max_batch: int = 4  # requests fused per dispatch (batch-dim padded)
     # pad partial chunks up to max_batch: one executable per bucket (the
     # serving default); False compiles one executable per seen chunk size
@@ -168,7 +176,10 @@ class Config:
             mesh=MeshConfig(**raw.get("mesh", {})),
             data=DataConfig(**raw.get("data", {})),
             train=_tuplify(TrainConfig(**raw.get("train", {})), "profile_steps"),
-            serve=_tuplify(ServeConfig(**raw.get("serve", {})), "buckets"),
+            serve=_tuplify(
+                _tuplify(ServeConfig(**raw.get("serve", {})), "buckets"),
+                "long_buckets",
+            ),
         )
 
     def apply_overrides(self, overrides: list[str]) -> "Config":

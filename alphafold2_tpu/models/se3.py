@@ -37,6 +37,16 @@ def _safe_norm(v, axis=-1, keepdims=False, eps=1e-8):
     return jnp.sqrt(sq + eps)
 
 
+def radial_basis(dist, num_basis: int = 16, max_dist: float = 20.0):
+    """Distances -> smooth RBF features. Plain function so the streamed
+    edge-attention path can evaluate it inside lax.scan (flax submodules
+    cannot be called under traced control flow); RadialBasis wraps it for
+    the module API. Parameter-free either way."""
+    centers = jnp.linspace(0.0, max_dist, num_basis)
+    width = max_dist / num_basis
+    return jnp.exp(-(((dist[..., None] - centers) / width) ** 2))
+
+
 class RadialBasis(nn.Module):
     """Distances -> smooth RBF features (invariant edge descriptors)."""
 
@@ -45,9 +55,7 @@ class RadialBasis(nn.Module):
 
     @nn.compact
     def __call__(self, dist):
-        centers = jnp.linspace(0.0, self.max_dist, self.num_basis)
-        width = self.max_dist / self.num_basis
-        return jnp.exp(-(((dist[..., None] - centers) / width) ** 2))
+        return radial_basis(dist, self.num_basis, self.max_dist)
 
 
 class EquivariantLayer(nn.Module):
@@ -65,36 +73,71 @@ class EquivariantLayer(nn.Module):
     num_basis: int = 16
     dtype: jnp.dtype = jnp.float32
 
+    # q-block / kv-chunk edge of the streamed long-chain path (elements of
+    # one (B, blk, blk) edge tile; all tiles are static shapes)
+    edge_block: int = 1024
+
     @nn.compact
     def __call__(self, s, v, coords, mask=None):
         b, n, ds = s.shape
         h = self.heads
         dh = self.dim // h
 
-        rel = coords[:, :, None, :] - coords[:, None, :, :]  # (B, N, N, 3)
-        dist = _safe_norm(rel)  # (B, N, N)
-        unit = rel / dist[..., None]
-        rbf = RadialBasis(self.num_basis)(dist).astype(self.dtype)  # (B,N,N,R)
+        # all parameterized submodules are created here with explicit names
+        # so the dense and streamed paths own the IDENTICAL parameter tree
+        rbf_basis = RadialBasis(self.num_basis)
+        rbf_bias = nn.Dense(h, dtype=self.dtype, name="rbf_bias")
+        edge_gate = nn.Dense(self.vec_dim, dtype=self.dtype, name="edge_gate")
 
         sn = nn.LayerNorm(dtype=self.dtype, name="s_norm")(s)
         q = nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="q")(sn)
         k = nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="k")(sn)
         q = q.reshape(b, n, h, dh)
         k = k.reshape(b, n, h, dh)
-        logits = jnp.einsum("bihd,bjhd->bhij", q, k) * dh**-0.5
-        logits = logits + jnp.moveaxis(
-            nn.Dense(h, dtype=self.dtype, name="rbf_bias")(rbf), -1, 1
-        )
-        if mask is not None:
-            pair = mask[:, None, None, :] & mask[:, None, :, None]
-            logits = jnp.where(pair, logits, MASK_VALUE)
-        attn = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(self.dtype)
-        attn_mean = attn.mean(axis=1)  # (B, N, N) head-averaged for vector agg
-
-        # scalar update: attended neighbor scalars + invariant vector norms
         vals = nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="val")(sn)
         vals = vals.reshape(b, n, h, dh)
-        s_agg = jnp.einsum("bhij,bjhd->bihd", attn, vals).reshape(b, n, self.dim)
+        v_mix = nn.DenseGeneral(
+            features=self.vec_dim, axis=-1, use_bias=False, dtype=self.dtype, name="v_mix"
+        )(jnp.swapaxes(v, -1, -2))  # (B, N, 3, dv) channel-mixed
+        v_mix = jnp.swapaxes(v_mix, -1, -2)  # (B, N, dv, 3)
+
+        from alphafold2_tpu.ops.chunked import should_chunk
+
+        # long-chain point clouds (serve buckets 512+ lift to 14L atoms):
+        # the dense path's (B, N, N, R) RBF edge tensor alone is GBs, so
+        # past the chunk threshold the edge features, attention and all
+        # three attended aggregations stream block-by-block with an online
+        # softmax — exact, same parameters, O(block^2) peak memory.
+        if should_chunk(b * self.num_basis, n, n):
+            s_agg, v_nbr, v_rel = self._streamed_attention(
+                b, n, h, dh, q, k, vals, v_mix, coords, mask,
+                rbf_basis, rbf_bias, edge_gate,
+            )
+        else:
+            rel = coords[:, :, None, :] - coords[:, None, :, :]  # (B,N,N,3)
+            dist = _safe_norm(rel)  # (B, N, N)
+            unit = rel / dist[..., None]
+            rbf = rbf_basis(dist).astype(self.dtype)  # (B, N, N, R)
+
+            logits = jnp.einsum("bihd,bjhd->bhij", q, k) * dh**-0.5
+            logits = logits + jnp.moveaxis(rbf_bias(rbf), -1, 1)
+            if mask is not None:
+                pair = mask[:, None, None, :] & mask[:, None, :, None]
+                logits = jnp.where(pair, logits, MASK_VALUE)
+            attn = jax.nn.softmax(
+                logits.astype(jnp.float32), axis=-1
+            ).astype(self.dtype)
+            attn_mean = attn.mean(axis=1)  # (B, N, N) head-averaged
+
+            s_agg = jnp.einsum("bhij,bjhd->bihd", attn, vals).reshape(
+                b, n, self.dim
+            )
+            v_nbr = jnp.einsum("bij,bjcd->bicd", attn_mean, v_mix)
+            v_rel = jnp.einsum(
+                "bij,bijc,bijd->bicd", attn_mean, edge_gate(rbf), unit
+            )
+
+        # scalar update: attended neighbor scalars + invariant vector norms
         v_norms = _safe_norm(v)  # (B, N, dv) invariant
         s_in = jnp.concatenate([s_agg, v_norms.astype(self.dtype)], axis=-1)
         s = s + nn.Dense(ds, dtype=self.dtype, name="s_out")(s_in)
@@ -107,21 +150,136 @@ class EquivariantLayer(nn.Module):
         )
         g_self, g_nbr, g_rel = jnp.split(gates, 3, axis=-1)
 
-        v_mix = nn.DenseGeneral(
-            features=self.vec_dim, axis=-1, use_bias=False, dtype=self.dtype, name="v_mix"
-        )(jnp.swapaxes(v, -1, -2))  # (B, N, 3, dv) channel-mixed
-        v_mix = jnp.swapaxes(v_mix, -1, -2)  # (B, N, dv, 3)
-
-        v_nbr = jnp.einsum("bij,bjcd->bicd", attn_mean, v_mix)  # (B, N, dv, 3)
-        edge_gate = nn.Dense(self.vec_dim, dtype=self.dtype, name="edge_gate")(rbf)
-        v_rel = jnp.einsum("bij,bijc,bijd->bicd", attn_mean, edge_gate, unit)
-
         v = v + (
             g_self[..., None] * v_mix
             + g_nbr[..., None] * v_nbr
             + g_rel[..., None] * v_rel
         )
         return s, v
+
+    def _streamed_attention(
+        self, b, n, h, dh, q, k, vals, v_mix, coords, mask,
+        rbf_basis, rbf_bias, edge_gate,
+    ):
+        """Online-softmax edge streaming: one (q-block, kv-chunk) tile of
+        rel/dist/RBF/logits is live at a time; the three attended
+        aggregations (neighbor scalars, neighbor vectors, gated relative
+        directions) share the running (max, denom) like ops/chunked.py.
+
+        lax.map over q blocks + lax.scan over kv chunks, so XLA's buffer
+        assignment genuinely reuses one tile (an unrolled python loop kept
+        every tile alive — 5 GB of temps at 14L = 7168 atoms; this form
+        measures ~tile-sized). Flax submodules cannot be CALLED under
+        traced control flow, so the edge Dense layers are materialized
+        once on a dummy row and their kernels applied as plain matmuls
+        inside the scan — same parameters, same math."""
+        blk = min(self.edge_block, n)
+        dv = self.vec_dim
+        f32 = jnp.float32
+        dt = self.dtype
+
+        # materialize the edge Dense params outside the scan (output
+        # unused -> DCE'd), then read their kernels for in-scan matmuls
+        dummy = jnp.zeros((1, self.num_basis), dt)
+        rbf_bias(dummy)
+        edge_gate(dummy)
+        bias_w = rbf_bias.variables["params"]["kernel"].astype(dt)
+        bias_b = rbf_bias.variables["params"]["bias"].astype(dt)
+        gate_w = edge_gate.variables["params"]["kernel"].astype(dt)
+        gate_b = edge_gate.variables["params"]["bias"].astype(dt)
+
+        pad = (-n) % blk
+        n_p = n + pad
+        eff_mask = mask if mask is not None else jnp.ones((b, n), bool)
+        if pad:  # padded rows are masked keys; padded q rows sliced off
+            eff_mask = jnp.pad(eff_mask, ((0, 0), (0, pad)))
+
+        def pad_n(t):
+            return jnp.pad(
+                t, ((0, 0), (0, pad)) + ((0, 0),) * (t.ndim - 2)
+            ) if pad else t
+
+        q_p, k_p = pad_n(q), pad_n(k)
+        vals_p, vmix_p, coords_p = pad_n(vals), pad_n(v_mix), pad_n(coords)
+        n_blocks = n_p // blk
+
+        def chunks(t, axis_to_front=1):
+            # (B, n_p, ...) -> (n_blocks, B, blk, ...)
+            return jnp.moveaxis(
+                t.reshape(t.shape[0], n_blocks, blk, *t.shape[2:]), 1, 0
+            )
+
+        k_s, vals_s = chunks(k_p), chunks(vals_p)
+        vmix_s, coords_s = chunks(vmix_p), chunks(coords_p)
+        mask_s = jnp.moveaxis(eff_mask.reshape(b, n_blocks, blk), 1, 0)
+
+        def q_block(args):
+            q_blk, c_blk, m_blk = args  # (B, blk, h, dh) / (B, blk, 3) / ..
+
+            def kv_step(carry, chunk):
+                m_run, l_run, acc_s, acc_nbr, acc_rel = carry
+                k_c, val_c, vm_c, c_c, km_c = chunk
+                rel = c_blk[:, :, None, :] - c_c[:, None, :, :]
+                dist = _safe_norm(rel)  # (B, blk_i, blk_j)
+                unit = rel / dist[..., None]
+                rbf = radial_basis(dist, self.num_basis).astype(dt)
+                logits = (
+                    jnp.einsum("bihd,bjhd->bhij", q_blk, k_c) * dh**-0.5
+                )
+                logits = logits + jnp.moveaxis(
+                    rbf @ bias_w + bias_b, -1, 1
+                )
+                pair = km_c[:, None, None, :] & m_blk[:, None, :, None]
+                logits = jnp.where(pair, logits, MASK_VALUE).astype(f32)
+                m_new = jnp.maximum(m_run, logits.max(axis=-1))
+                p = jnp.exp(logits - m_new[..., None])
+                r = jnp.exp(m_run - m_new)
+                l_new = l_run * r + p.sum(axis=-1)
+                acc_s = acc_s * r[..., None] + jnp.einsum(
+                    "bhij,bjhd->bhid", p, val_c.astype(f32)
+                )
+                acc_nbr = acc_nbr * r[..., None, None] + jnp.einsum(
+                    "bhij,bjcd->bhicd", p, vm_c.astype(f32)
+                )
+                acc_rel = acc_rel * r[..., None, None] + jnp.einsum(
+                    "bhij,bijc,bijd->bhicd",
+                    p,
+                    (rbf @ gate_w + gate_b).astype(f32),
+                    unit.astype(f32),
+                )
+                return (m_new, l_new, acc_s, acc_nbr, acc_rel), None
+
+            init = (
+                jnp.full((b, h, blk), -jnp.inf, f32),
+                jnp.zeros((b, h, blk), f32),
+                jnp.zeros((b, h, blk, dh), f32),
+                jnp.zeros((b, h, blk, dv, 3), f32),
+                jnp.zeros((b, h, blk, dv, 3), f32),
+            )
+            (m_run, l_run, acc_s, acc_nbr, acc_rel), _ = jax.lax.scan(
+                kv_step, init, (k_s, vals_s, vmix_s, coords_s, mask_s)
+            )
+            inv_l = 1.0 / jnp.maximum(l_run, 1e-30)  # (B, h, blk)
+            s_blk = (
+                jnp.moveaxis(acc_s * inv_l[..., None], 1, 2)
+                .reshape(b, blk, self.dim)
+                .astype(dt)
+            )
+            nbr_blk = (acc_nbr * inv_l[..., None, None]).mean(axis=1)
+            rel_blk = (acc_rel * inv_l[..., None, None]).mean(axis=1)
+            return s_blk, nbr_blk.astype(v_mix.dtype), rel_blk.astype(
+                v_mix.dtype
+            )
+
+        s_b, nbr_b, rel_b = jax.lax.map(
+            q_block, (chunks(q_p), coords_s, mask_s)
+        )
+
+        def unblock(t):  # (n_blocks, B, blk, ...) -> (B, n, ...)
+            t = jnp.moveaxis(t, 0, 1)
+            return t.reshape(b, n_p, *t.shape[3:])[:, :n]
+
+        return unblock(s_b), unblock(nbr_b), unblock(rel_b)
 
 
 class SE3Transformer(nn.Module):

@@ -63,6 +63,27 @@ def step_flops(compiled) -> Optional[float]:
     return executable_costs(compiled)["flops"]
 
 
+def executable_memory(compiled) -> dict:
+    """Per-device memory footprint of one compiled executable from XLA's
+    ``memory_analysis()``: ``argument_bytes`` / ``output_bytes`` /
+    ``temp_bytes`` (+ their sum ``program_bytes``). For SPMD programs these
+    are PER-DEVICE numbers — exactly the quantity the pair-grid sharding
+    exists to shrink, and what the serve compile records and the mesh
+    regression gate key on. ``{}`` when the backend exposes nothing (the
+    accounting must never break a measurement)."""
+    try:
+        ma = compiled.memory_analysis()
+        out = {
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+        }
+        out["program_bytes"] = sum(out.values())
+        return out
+    except Exception:
+        return {}
+
+
 def device_peak_flops(device=None) -> Optional[float]:
     """Published peak dense bf16 FLOPs/s of ``device`` (default: the first
     jax device); None for chips the table does not know (CPUs included)."""
@@ -99,3 +120,72 @@ def mfu(
 def estimate_mfu(compiled, step_seconds: float) -> Optional[float]:
     """MFU of one executed step of ``compiled`` taking ``step_seconds``."""
     return mfu(step_flops(compiled), step_seconds)
+
+
+# one measured-peak probe per process (keyed by device kind)
+_CALIBRATED: dict = {}
+
+
+def calibrated_peak_flops(device=None, n: int = 1024, iters: int = 8):
+    """MEASURED dense-matmul peak FLOPs/s for chips the published table
+    does not know (the CPU mesh above all): times a jitted f32 matmul of
+    known cost. This is what lets the serve bench report an honest MFU on
+    the 8-virtual-device CPU mesh — utilization against the host's own
+    measured matmul roofline, labeled as such (``mfu_basis``), never
+    against a made-up CPU "peak". Virtual devices share the physical
+    silicon, so the calibration is per HOST and callers must not multiply
+    it by the virtual device count. Cached per device kind."""
+    import time
+
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        device = device if device is not None else jax.devices()[0]
+        kind = device.device_kind
+        if kind in _CALIBRATED:
+            return _CALIBRATED[kind]
+        x = jax.device_put(jnp.ones((n, n), jnp.float32), device)
+        f = jax.jit(lambda a: a @ a)
+        jax.block_until_ready(f(x))  # compile + warm outside the timing
+        t0 = time.perf_counter()
+        y = x
+        for _ in range(iters):
+            y = f(y)
+        jax.block_until_ready(y)
+        peak = iters * 2 * n**3 / max(time.perf_counter() - t0, 1e-9)
+        _CALIBRATED[kind] = peak
+        return peak
+    except Exception:
+        return None
+
+
+def mesh_mfu(flops: Optional[float], seconds: float, mesh=None) -> dict:
+    """MFU of a (possibly sharded) program: ``{"mfu": ..., "mfu_basis":
+    "published-peak" | "calibrated-matmul"}`` (empty values -> {"mfu":
+    None}). On chips with a published peak the denominator is
+    peak * n_devices (the multi-chip MFU the ROADMAP wants from the
+    sharded serve path); on unknown chips (CPU mesh) it is the measured
+    host matmul roofline — virtual devices share silicon, so no
+    multiplier."""
+    if not flops or not seconds or seconds <= 0:
+        return {"mfu": None}
+    peak = device_peak_flops()
+    if peak is not None:
+        n_dev = 1
+        if mesh is not None:
+            try:
+                n_dev = int(mesh.devices.size)
+            except Exception:
+                n_dev = 1
+        return {
+            "mfu": flops / seconds / (peak * max(1, n_dev)),
+            "mfu_basis": "published-peak",
+        }
+    peak = calibrated_peak_flops()
+    if not peak:
+        return {"mfu": None}
+    return {
+        "mfu": flops / seconds / peak,
+        "mfu_basis": "calibrated-matmul",
+    }

@@ -60,7 +60,12 @@ class MemorySampler:
         ]
         return max(peaks) if peaks else None
 
-    def log_to(self, logger, step: int = 0) -> None:
+    def log_to(self, logger, step: int = 0, per_device: bool = False) -> None:
+        """Emit an HBM summary record; ``per_device=True`` additionally
+        logs one ``hbm/device<N>/peak_bytes`` key per device — the
+        mesh-serving view (obs_report's sharding section reads these), so
+        an uneven shard (one device holding the unsharded pair grid) is
+        visible instead of averaged away."""
         records = self.sample()
         if not records:
             return
@@ -73,6 +78,12 @@ class MemorySampler:
             ),
             "hbm_devices": len(records),
         }
+        if per_device:
+            for r in records:
+                if "peak_bytes_in_use" in r:
+                    summary[f"hbm/device{r['device']}/peak_bytes"] = r[
+                        "peak_bytes_in_use"
+                    ]
         logger.log(step, summary)
 
     def counter_to(self, tracer) -> None:

@@ -13,8 +13,9 @@ CI gate) and anything else that wants a verdict:
   value-0.0 watchdog records, withdrawn baselines).
 - **comparability** — :func:`comparable_reason` requires the same metric
   label, the same device kind (a CPU-mesh number vs a TPU number is not a
-  comparison) and, for train-bench records, the same in-graph step count
-  (the timing methodology).
+  comparison), the same mesh identity (a sharded record vs a single-device
+  one is not a comparison either) and, for train-bench records, the same
+  in-graph step count (the timing methodology).
 - **thresholds** — per-metric direction + tolerated fractional change;
   anything past tolerance in the bad direction regresses the verdict.
 
@@ -58,12 +59,29 @@ SERVE_ASYNC_THRESHOLDS = {
     "rejection_rate": ("lower", 1.00),
 }
 
+# mesh-sharded serve records (a "mesh" key beside mode=serve): throughput
+# and latency get the wide cross-machine tolerances (the committed baseline
+# is a CPU-mesh record; CI runners differ in core count), while the
+# per-device program footprint gets a tight-ish one — it is DETERMINISTIC
+# per (program, jax version), and a 2x jump is exactly the forgot-the-
+# sharding-constraint cliff (an unsharded pair grid on a 2x4 grid mesh is
+# 8x per device) this gate exists to catch.
+SERVE_MESH_THRESHOLDS = {
+    "value": ("higher", 0.60),
+    "p50_ms": ("lower", 2.50),
+    "p95_ms": ("lower", 2.50),
+    "p99_ms": ("lower", 2.50),
+    "per_device_program_bytes": ("lower", 1.00),
+}
+
 
 def thresholds_for(record) -> dict:
     """The gate's per-metric direction/tolerance table for this record's
-    shape (keyed by the record's ``mode``)."""
+    shape (keyed by the record's ``mode`` and mesh identity)."""
     if isinstance(record, dict) and record.get("mode") == "serve-async":
         return SERVE_ASYNC_THRESHOLDS
+    if isinstance(record, dict) and record.get("mesh"):
+        return SERVE_MESH_THRESHOLDS
     return DEFAULT_THRESHOLDS
 
 
@@ -100,6 +118,14 @@ def comparable_reason(current: dict, baseline: dict) -> Optional[str]:
     cur_dev, base_dev = current.get("device"), baseline.get("device")
     if cur_dev and base_dev and cur_dev != base_dev:
         return f"device mismatch: current={cur_dev!r} baseline={base_dev!r}"
+    if current.get("mesh") != baseline.get("mesh"):
+        # records grew a mesh key (sharded serving): a sharded number vs a
+        # single-device one — or two different mesh shapes — is not a
+        # comparison even when the device kind matches
+        return (
+            f"mesh mismatch: current={current.get('mesh')!r} "
+            f"baseline={baseline.get('mesh')!r}"
+        )
     if "ingraph" in baseline and baseline.get("ingraph") != current.get(
         "ingraph"
     ):
@@ -139,8 +165,10 @@ def compare(
     Returns ``{"verdict": "pass"|"regress"|"no-data", ...}`` with a
     ``reason`` for no-data and per-metric ``comparisons`` otherwise. Only
     metrics present in BOTH records and named in ``thresholds`` are gated.
+    ``thresholds=None`` routes by the record's shape (:func:`thresholds_for`)
+    — serve-async and mesh-serve records get their own tables.
     """
-    thresholds = thresholds if thresholds is not None else DEFAULT_THRESHOLDS
+    thresholds = thresholds if thresholds is not None else thresholds_for(current)
     out = {
         "metric": current.get("metric") if isinstance(current, dict) else None,
         "device": current.get("device") if isinstance(current, dict) else None,

@@ -157,7 +157,6 @@ class Attention(nn.Module):
         exact dense attention); no tied rows / compression / broadcast
         context here."""
         dh = self.dim_head
-        attn_fn = None
         if self._use_flash():
             from alphafold2_tpu.ops.flash import flash_attention
 
@@ -165,6 +164,13 @@ class Attention(nn.Module):
                 return flash_attention(
                     q2, k2, v2, q_mask=m2, kv_mask=m2, sm_scale=dh**-0.5
                 )
+        else:
+            # off-TPU long-chain path: exact streamed attention once the
+            # per-device logits would cross the chunk threshold; declines
+            # (returns None) below it so small shapes stay dense
+            from alphafold2_tpu.ops.chunked import chunked_attn_fn
+
+            attn_fn = chunked_attn_fn(dh**-0.5)
 
         return grid_axial_project_attend(
             self.to_q, self.to_kv, self.to_out, self.heads, dh,
@@ -279,6 +285,28 @@ class Attention(nn.Module):
                 sm_scale=scale,
             )
             if out is not None:
+                return project_out(out)
+
+        # exact streamed attention off-TPU once the dense logits would
+        # cross the chunk threshold (ops/chunked.py): the long-chain serve
+        # buckets' N^2-query cross-attention would otherwise materialize
+        # tens of GB. Below the threshold the dense path (and its
+        # committed graph fingerprints) is untouched.
+        if fused_ok:
+            from alphafold2_tpu.ops.chunked import (
+                chunked_attention,
+                should_chunk,
+            )
+
+            if should_chunk(q.shape[0] * h, q.shape[1], k.shape[1]):
+                out = chunked_attention(
+                    heads_first(q),
+                    heads_first(k),
+                    heads_first(v),
+                    q_mask=mask,
+                    kv_mask=kv_mask,
+                    sm_scale=scale,
+                )
                 return project_out(out)
 
         if tie_dim is not None:

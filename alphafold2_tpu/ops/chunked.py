@@ -1,0 +1,212 @@
+"""Exact chunked (online-softmax) attention for long-chain serving off-TPU.
+
+The flash kernels (ops/flash.py) keep the N^2 attention matrix out of HBM,
+but they are TPU-only — on the CPU mesh (and any backend without the Pallas
+kernels) the dense jnp path materializes the full (B, H, Nq, Nk) logits.
+At the serve ladder's long-chain rungs that is fatal: bucket 512 elongates
+to N = 1536 pair tokens, and the N^2-query cross-attention alone would
+build a ~50 GB logits tensor. This module is the backend-agnostic answer:
+the classic two-level streaming formulation (Rabe & Staats; the same
+recurrence the flash kernels hard-code) as plain jnp + ``lax.scan``:
+
+- queries are processed in blocks (``lax.map`` — sequential, so only one
+  block's intermediates are ever live);
+- keys/values are streamed in chunks with a running (max, denominator,
+  numerator) carry — softmax renormalized online, so the result is the
+  EXACT dense softmax up to float reassociation (~1e-6), not an
+  approximation;
+- masking matches the dense path bit-for-bit in semantics: masked keys get
+  ``MASK_VALUE`` logits *before* the online max, so fully-masked rows
+  degrade to the same uniform attention the dense softmax produces.
+
+Peak memory is O(q_chunk * kv_chunk) per (batch, head) instead of
+O(Nq * Nk). ``should_chunk`` is the one routing policy: dense below
+``CHUNK_THRESHOLD`` logits elements (the small-shape graphs — and their
+committed contract fingerprints — stay byte-identical), chunked above.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+# logits elements (batch * heads * Nq * Nk) above which attention streams
+# through the chunked path: 2**28 elements is ~1 GiB of f32 logits, past
+# any shape the single-device serve/train flagships produce — their graphs
+# (and the committed graph_contracts.json fingerprints) are unchanged.
+CHUNK_THRESHOLD = int(os.environ.get("AF2TPU_ATTN_CHUNK_THRESHOLD", 2**28))
+
+# per-tile logits budget (elements): chunk sizes adapt so one
+# (batch*heads, q_chunk, kv_chunk) tile stays ~64 MiB of f32 whatever the
+# batch dim is — the grid-sharded axial passes carry the row axis in batch
+# (hundreds of rows), the flat cross-attention carries batch=B*heads only
+TILE_ELEMENTS = int(os.environ.get("AF2TPU_ATTN_TILE_ELEMENTS", 2**24))
+
+MASK_VALUE = -1e9  # keep in sync with ops.attention.MASK_VALUE
+
+
+def _auto_chunk(batch_heads: int, n: int) -> int:
+    """Largest power-of-two chunk (>=128, <=4096) whose tile fits the
+    element budget for this batch size."""
+    c = 4096
+    while c > 128 and batch_heads * c * c > TILE_ELEMENTS:
+        c //= 2
+    return min(c, max(128, n))
+
+
+def should_chunk(batch_heads: int, nq: int, nk: int) -> bool:
+    """True when the dense (batch*heads, Nq, Nk) logits tensor is past the
+    streaming threshold. All inputs are trace-time constants, so the
+    decision is static per executable shape."""
+    if CHUNK_THRESHOLD <= 0:
+        return False
+    return int(batch_heads) * int(nq) * int(nk) >= CHUNK_THRESHOLD
+
+
+def _pad_axis(t, axis: int, pad: int, value=0):
+    if pad == 0:
+        return t
+    widths = [(0, 0)] * t.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(t, widths, constant_values=value)
+
+
+def chunked_attention(
+    q: jnp.ndarray,  # (B, H, Nq, D)
+    k: jnp.ndarray,  # (B, H, Nk, D)
+    v: jnp.ndarray,
+    q_mask: Optional[jnp.ndarray] = None,  # (B, Nq) bool valid-query
+    kv_mask: Optional[jnp.ndarray] = None,  # (B, Nk) bool valid-key
+    sm_scale: float = 1.0,
+    q_chunk: Optional[int] = None,
+    kv_chunk: Optional[int] = None,
+) -> jnp.ndarray:
+    """Exact attention with streamed logits; same contract as
+    ops.flash.flash_attention (which it mirrors off-TPU) except it always
+    succeeds. Masked queries produce zeros (the flash SegmentIds
+    convention); masked keys are excluded exactly as the dense path's
+    additive MASK_VALUE bias. Chunk sizes default to the largest tile
+    within ``TILE_ELEMENTS`` for this batch*heads."""
+    b, h, nq, d = q.shape
+    nk = k.shape[2]
+    q_chunk = min(q_chunk or _auto_chunk(b * h, nq), nq)
+    kv_chunk = min(kv_chunk or _auto_chunk(b * h, nk), nk)
+    pad_q = (-nq) % q_chunk
+    pad_k = (-nk) % kv_chunk
+
+    if pad_k and kv_mask is None:
+        kv_mask = jnp.ones((b, nk), dtype=bool)
+    q = _pad_axis(q, 2, pad_q)
+    k = _pad_axis(k, 2, pad_k)
+    v = _pad_axis(v, 2, pad_k)
+    if kv_mask is not None:
+        kv_mask = _pad_axis(kv_mask, 1, pad_k, value=False)
+    if q_mask is not None:
+        q_mask = _pad_axis(q_mask, 1, pad_q, value=False)
+    nq_p, nk_p = nq + pad_q, nk + pad_k
+    n_qb, n_kb = nq_p // q_chunk, nk_p // kv_chunk
+
+    # kv chunks as scan inputs: (n_kb, B, H, kv_chunk, D)
+    k_s = jnp.moveaxis(k.reshape(b, h, n_kb, kv_chunk, d), 2, 0)
+    v_s = jnp.moveaxis(v.reshape(b, h, n_kb, kv_chunk, d), 2, 0)
+    if kv_mask is not None:
+        m_s = jnp.moveaxis(kv_mask.reshape(b, n_kb, kv_chunk), 1, 0)
+    else:
+        m_s = None
+
+    def q_block(args):
+        q_blk = args[0]
+
+        def kv_step(carry, chunk):
+            m_run, l_run, acc = carry
+            if m_s is not None:
+                k_c, v_c, km_c = chunk
+            else:
+                k_c, v_c = chunk
+                km_c = None
+            logits = (
+                jnp.einsum("bhqd,bhkd->bhqk", q_blk, k_c).astype(jnp.float32)
+                * sm_scale
+            )
+            if km_c is not None:
+                logits = jnp.where(
+                    km_c[:, None, None, :], logits, MASK_VALUE
+                )
+            m_new = jnp.maximum(m_run, logits.max(axis=-1))
+            p = jnp.exp(logits - m_new[..., None])
+            rescale = jnp.exp(m_run - m_new)
+            l_new = l_run * rescale + p.sum(axis=-1)
+            acc_new = acc * rescale[..., None] + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, v_c.astype(jnp.float32)
+            )
+            return (m_new, l_new, acc_new), None
+
+        init = (
+            jnp.full((b, h, q_chunk), -jnp.inf, jnp.float32),
+            jnp.zeros((b, h, q_chunk), jnp.float32),
+            jnp.zeros((b, h, q_chunk, d), jnp.float32),
+        )
+        xs = (k_s, v_s) if m_s is None else (k_s, v_s, m_s)
+        (m_run, l_run, acc), _ = lax.scan(kv_step, init, xs)
+        out = acc / jnp.maximum(l_run, 1e-30)[..., None]
+        if len(args) > 1:  # masked queries emit zeros (flash convention)
+            out = jnp.where(args[1][:, None, :, None], out, 0.0)
+        return out.astype(q.dtype)
+
+    # lax.map over query blocks: sequential, one block live at a time
+    q_b = jnp.moveaxis(q.reshape(b, h, n_qb, q_chunk, d), 2, 0)
+    if q_mask is not None:
+        qm_b = jnp.moveaxis(q_mask.reshape(b, n_qb, q_chunk), 1, 0)
+        xs_q = (q_b, qm_b)
+    else:
+        xs_q = (q_b,)
+    out = lax.map(q_block, xs_q)  # (n_qb, B, H, q_chunk, D)
+    out = jnp.moveaxis(out, 0, 2).reshape(b, h, nq_p, d)
+    return out[:, :, :nq]
+
+
+def chunked_attn_fn(sm_scale: float):
+    """An ``attn_fn`` hook for the grid-sharded axial passes
+    (parallel.grid_parallel._attend_last_grid_axis): takes row-flattened
+    ``(B*R, H, N, D)`` q/k/v and a ``(B*R, N)`` key mask, returns the
+    attended values in the same layout — or None (trace-time decline) when
+    the dense logits are below the streaming threshold, keeping small
+    shapes on the dense path."""
+
+    def attn_fn(q2, k2, v2, m2):
+        bsz, h, n, _ = q2.shape
+        if not should_chunk(bsz * h, n, n):
+            return None
+        return chunked_attention(
+            q2, k2, v2, q_mask=None, kv_mask=m2, sm_scale=sm_scale
+        )
+
+    # shape-only pre-probe (grid_parallel._attend_last_grid_axis): lets
+    # the caller skip even the row-flattening ops when this hook would
+    # decline, so small-shape jaxprs stay byte-identical to the
+    # no-hook form
+    attn_fn.accepts = lambda bsz, h, n: should_chunk(bsz * h, n, n)
+    return attn_fn
+
+
+def online_softmax_update(m_run, l_run, accs, logits, values):
+    """One streaming-softmax accumulation step shared with consumers that
+    fold extra per-edge aggregations into the same normalizer (the SE(3)
+    refiner's vector updates): given this chunk's ``logits``
+    (..., q, kchunk) f32 and a list of ``values`` each (..., q, kchunk, *),
+    rescales the running (max, denom, numerators) and returns the updated
+    carry. All numerators share the softmax normalizer ``l_run``."""
+    m_new = jnp.maximum(m_run, logits.max(axis=-1))
+    p = jnp.exp(logits - m_new[..., None])
+    rescale = jnp.exp(m_run - m_new)
+    l_new = l_run * rescale + p.sum(axis=-1)
+    new_accs = []
+    for acc, val in zip(accs, values):
+        extra = val.ndim - p.ndim
+        w = p.reshape(p.shape + (1,) * extra)
+        r = rescale.reshape(rescale.shape + (1,) * (acc.ndim - rescale.ndim))
+        new_accs.append(acc * r + (w * val).sum(axis=p.ndim - 1))
+    return m_new, l_new, new_accs

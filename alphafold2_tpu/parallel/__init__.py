@@ -2,7 +2,9 @@ from alphafold2_tpu.parallel.sharding import (
     DATA_AXIS,
     SEQ_AXIS,
     active_mesh,
+    describe_mesh,
     make_mesh,
+    parse_mesh_spec,
     shard_batch,
     shard_msa,
     shard_pair,

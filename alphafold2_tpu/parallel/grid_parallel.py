@@ -91,13 +91,19 @@ def _attend_last_grid_axis(q, k, v, mask, attn_fn=None):
     unmasked fast paths (e.g. flash without SegmentIds)."""
     b, r, n, h, d = q.shape
     if attn_fn is not None:
-        def flat(t):  # (B, R, N, H, D) -> (B*R, H, N, D)
-            return jnp.moveaxis(t.reshape(b * r, n, h, d), 2, 1)
+        # shape-only pre-probe: a hook exposing ``accepts`` can decline
+        # from the static shape alone, BEFORE the row-flattening ops are
+        # traced — a declined call must leave zero footprint in the jaxpr
+        # (the graph contracts fingerprint dead eqns too)
+        accepts = getattr(attn_fn, "accepts", None)
+        if accepts is None or accepts(b * r, h, n):
+            def flat(t):  # (B, R, N, H, D) -> (B*R, H, N, D)
+                return jnp.moveaxis(t.reshape(b * r, n, h, d), 2, 1)
 
-        m2 = mask.reshape(b * r, n) if mask is not None else None
-        out = attn_fn(flat(q), flat(k), flat(v), m2)
-        if out is not None:
-            return jnp.moveaxis(out, 1, 2).reshape(b, r, n, h, d)
+            m2 = mask.reshape(b * r, n) if mask is not None else None
+            out = attn_fn(flat(q), flat(k), flat(v), m2)
+            if out is not None:
+                return jnp.moveaxis(out, 1, 2).reshape(b, r, n, h, d)
     scale = d**-0.5
     dots = jnp.einsum("brihd,brjhd->brhij", q, k).astype(jnp.float32) * scale
     if mask is not None:

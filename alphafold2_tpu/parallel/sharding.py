@@ -164,3 +164,33 @@ def shard_batch(t):
 
 def replicated(t):
     return _constrain(t, P())
+
+
+def describe_mesh(mesh: Optional[Mesh]) -> Optional[str]:
+    """Stable mesh-identity string, e.g. ``"dp1.spr2.spc4"`` — the key the
+    serve executable cache, result cache, bench records and regression gate
+    all share, so a CPU-mesh number can never silently compare against a
+    differently-sharded (or unsharded) one. None for no mesh."""
+    if mesh is None:
+        return None
+    return ".".join(
+        f"{name}{size}" for name, size in zip(mesh.axis_names, mesh.devices.shape)
+    )
+
+
+def parse_mesh_spec(spec: Optional[str]) -> Optional[Mesh]:
+    """Build a mesh from a compact CLI/env spec: ``"DPxSPRxSPC"`` (three
+    ints — a 2D pair-grid mesh, parallel/grid_parallel.py) or ``"DPxSP"``
+    (two ints — the 1D (dp, sp) mesh). Empty/None -> no mesh."""
+    if not spec:
+        return None
+    parts = [int(p) for p in spec.lower().replace("x", " ").split()]
+    if len(parts) == 3:
+        from alphafold2_tpu.parallel.grid_parallel import make_grid_mesh
+
+        return make_grid_mesh(*parts)
+    if len(parts) == 2:
+        return make_mesh(parts[0], parts[1])
+    raise ValueError(
+        f"mesh spec {spec!r} must be 'dpxsprxspc' (grid) or 'dpxsp' (1D)"
+    )

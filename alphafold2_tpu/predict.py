@@ -52,8 +52,17 @@ def realize_structure(
     residues. ``per_position_init`` keys each position's MDS start by its
     absolute index so the valid-region solve is reproducible across padded
     bucket shapes (see utils/mds.py)."""
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    from alphafold2_tpu.parallel.sharding import shard_pair
+
+    # identity without an active mesh; under one (sharded serving), the
+    # realization-stage pair tensors — logits (B,N,N,K) f32, probs, and
+    # the (B,N,N) distance/weight maps — stay on the pair-grid layout
+    # instead of being silently replicated per device (at bucket 512 the
+    # replicated realization alone was ~3 GB/device)
+    logits = shard_pair(logits)
+    probs = shard_pair(jax.nn.softmax(logits.astype(jnp.float32), axis=-1))
     distances, weights = center_distogram(probs)
+    distances, weights = shard_pair(distances), shard_pair(weights)
     residue_mask = None
     if mask is not None:
         pair_valid = mask[:, :, None] & mask[:, None, :]

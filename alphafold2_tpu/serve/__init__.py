@@ -16,7 +16,7 @@ from alphafold2_tpu.serve.bucketing import (
     padding_fraction,
     validate_ladder,
 )
-from alphafold2_tpu.serve.cache import ResultCache
+from alphafold2_tpu.serve.cache import ResultCache, result_key
 from alphafold2_tpu.serve.engine import ServeEngine, ServeRequest, ServeResult
 from alphafold2_tpu.serve.faults import FaultPlan, InjectedFault
 from alphafold2_tpu.serve.scheduler import AsyncServeFrontend, PendingResult
@@ -33,5 +33,6 @@ __all__ = [
     "bucket_for",
     "geometric_ladder",
     "padding_fraction",
+    "result_key",
     "validate_ladder",
 ]

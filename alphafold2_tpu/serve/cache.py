@@ -27,6 +27,17 @@ from collections import OrderedDict
 from typing import Optional, Tuple
 
 
+def result_key(seq: str, seed: int, mesh_desc: Optional[str] = None) -> tuple:
+    """The canonical result-cache / in-flight-dedup key. Outputs are
+    deterministic in ``(seq, seed)`` on a FIXED execution layout, but a
+    sharded executable's floats are only equal to the single-device ones
+    to ~1e-4 (reduction order differs) — so the mesh identity
+    (``parallel.sharding.describe_mesh``) is part of the key, and results
+    computed on one layout are never served as byte-identical answers for
+    another."""
+    return (seq, int(seed), mesh_desc)
+
+
 class InFlightEntry:
     """One key's in-flight record: the leader token plus the follower
     contexts (opaque to the cache — the scheduler registers its pending

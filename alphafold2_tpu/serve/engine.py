@@ -33,11 +33,13 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from contextlib import nullcontext
 from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from alphafold2_tpu import constants
 from alphafold2_tpu.config import Config
@@ -48,7 +50,12 @@ from alphafold2_tpu.observe import (
     MemorySampler,
     Tracer,
 )
-from alphafold2_tpu.observe.flops import executable_costs
+from alphafold2_tpu.observe.flops import executable_costs, executable_memory
+from alphafold2_tpu.parallel.sharding import (
+    DATA_AXIS,
+    describe_mesh,
+    use_mesh,
+)
 from alphafold2_tpu.predict import encode_sequence
 from alphafold2_tpu.serve.bucketing import bucket_for, validate_ladder
 from alphafold2_tpu.train.end2end import End2EndModel
@@ -138,22 +145,61 @@ class ServeEngine:
         counters: Optional[EventCounters] = None,
         tracer: Optional[Tracer] = None,
         faults=None,
+        mesh: Optional[Mesh] = None,
     ):
         # faults: an optional serve.faults.FaultPlan consulted at the top of
         # every dispatch — the injection point that makes the scheduler's
         # retry and graceful-degradation paths testable
         self.faults = faults
         self.cfg = cfg
+        # mesh: an optional jax device mesh ((dp, sp) from
+        # parallel.sharding.make_mesh or (dp, spr, spc) from
+        # parallel.grid_parallel.make_grid_mesh). With one, every
+        # executable is AOT-compiled sharded (batch over dp, the pair grid
+        # over the sequence axes via the model's shard_pair constraints)
+        # and dispatch device_puts with explicit shardings; without one the
+        # engine is the unchanged single-device path. The mesh identity is
+        # part of the executable cache key, so one engine could in
+        # principle be rebuilt against a different mesh without stale hits.
+        self.mesh = mesh
+        self.mesh_desc = describe_mesh(mesh)
         self.buckets = validate_ladder(cfg.serve.buckets)
+        self.long_buckets: tuple = ()
+        if cfg.serve.long_buckets:
+            long = validate_ladder(cfg.serve.long_buckets)
+            if mesh is None:
+                # the mesh gate: long-chain rungs' O(N^2) pair state is
+                # exactly what a single device cannot hold — refuse them
+                # loudly instead of OOMing mid-dispatch
+                raise ValueError(
+                    f"serve.long_buckets={long} require a device mesh: "
+                    "the long-chain rungs are mesh-gated (construct "
+                    "ServeEngine with mesh=..., e.g. "
+                    "parallel.grid_parallel.make_grid_mesh)"
+                )
+            if long[0] <= self.buckets[-1]:
+                raise ValueError(
+                    f"serve.long_buckets {long} must all exceed the top "
+                    f"regular rung {self.buckets[-1]}"
+                )
+            self.long_buckets = long
+            self.buckets = self.buckets + long
         self.max_batch = int(cfg.serve.max_batch)
+        self.long_max_batch = int(cfg.serve.long_max_batch)
         if self.max_batch < 1:
             raise ValueError(f"serve.max_batch must be >= 1, got {self.max_batch}")
+        if self.long_buckets and self.long_max_batch < 1:
+            raise ValueError(
+                f"serve.long_max_batch must be >= 1, got {self.long_max_batch}"
+            )
         if 3 * self.buckets[-1] > cfg.model.max_seq_len:
             raise ValueError(
                 f"largest bucket {self.buckets[-1]} elongates to "
                 f"{3 * self.buckets[-1]} tokens > model.max_seq_len="
                 f"{cfg.model.max_seq_len}; raise it or trim serve.buckets"
             )
+        if mesh is not None:
+            self._validate_mesh(mesh, cfg)
         self.msa_depth = int(cfg.serve.msa_depth or cfg.data.msa_depth)
         if self.msa_depth > constants.MAX_NUM_MSA:
             raise ValueError(
@@ -182,11 +228,61 @@ class ServeEngine:
             mds_per_position_init=True,
             remat=cfg.model.remat, msa_tie_row_attn=cfg.model.msa_tie_row_attn,
             context_parallel=cfg.model.context_parallel,
+            grid_parallel=cfg.model.grid_parallel,
             dtype=jnp.bfloat16 if cfg.model.bfloat16 else jnp.float32,
         )
         self.params = self._init_params(params, checkpoint_dir)
         self._mds_key = jax.random.key(cfg.train.seed)
         self._executables: dict = {}
+        # params replicated onto the mesh once, reused by every sharded
+        # dispatch (a sharded executable rejects differently-placed inputs)
+        self._mesh_params = None
+
+    def _validate_mesh(self, mesh: Mesh, cfg: Config) -> None:
+        from alphafold2_tpu.parallel.grid_parallel import (
+            COL_AXIS_NAME,
+            ROW_AXIS_NAME,
+        )
+
+        axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        n_dp = axes.get(DATA_AXIS, 1)
+        if self.max_batch % n_dp or (
+            self.long_buckets and self.long_max_batch % n_dp
+        ):
+            raise ValueError(
+                f"serve batch sizes (max_batch={self.max_batch}, "
+                f"long_max_batch={self.long_max_batch}) must divide by the "
+                f"mesh's dp axis ({n_dp}) for even batch sharding"
+            )
+        if ROW_AXIS_NAME in axes:
+            if not cfg.model.grid_parallel:
+                # same refusal as train/loop.py: without the sharded axial
+                # primitive GSPMD all-gathers the attended axis and the
+                # per-device memory win silently evaporates
+                raise ValueError(
+                    "a (dp, spr, spc) grid mesh requires "
+                    "model.grid_parallel=true — without it the axial "
+                    "passes run dense and the long-chain rungs lose their "
+                    "O(N^2/(spr*spc)) per-device memory"
+                )
+            tile = axes[ROW_AXIS_NAME] * axes.get(COL_AXIS_NAME, 1)
+            for b in self.buckets:
+                if (3 * b) % tile:
+                    raise ValueError(
+                        f"bucket {b} elongates to {3 * b} pair rows, not "
+                        f"divisible by the spr*spc tile ({tile}) the "
+                        "all-to-all transposes need; adjust serve.buckets "
+                        "or the mesh"
+                    )
+
+    def batch_for(self, bucket: int) -> int:
+        """Dispatch batch size for one rung: long-chain rungs batch
+        ``serve.long_max_batch`` (their per-request memory is what the mesh
+        shards), everything else ``serve.max_batch``."""
+        return (
+            self.long_max_batch
+            if bucket in self.long_buckets else self.max_batch
+        )
 
     # ---------------------------------------------------------------- params
 
@@ -244,22 +340,37 @@ class ServeEngine:
         return picked
 
     def _get_executable(self, bucket: int, batch: int):
-        """One compiled executable per (bucket, batch) shape, AOT-built.
+        """One compiled executable per (bucket, batch, mesh) shape, AOT-
+        built. The mesh identity in the key is what lets sharded and
+        single-device executables (and their compile records) coexist.
 
         The in-process dict makes reuse O(1); the persistent XLA compilation
         cache behind it (enable_compile_cache) makes even the first build of
         a known HLO a deserialization instead of a compile."""
-        key = (bucket, batch)
+        key = (bucket, batch, self.mesh_desc)
         hit = self._executables.get(key)
         if hit is not None:
             self.counters.bump("serve.cache_hits")
             return hit
         donate = (1, 2, 3, 4) if self.cfg.serve.donate_buffers else ()
         abstract = self._abstract_batch(bucket, batch)
+        jit_kwargs: dict = {"donate_argnums": donate}
+        if self.mesh is not None:
+            # explicit input shardings: params replicated, every request
+            # buffer batch-sharded over dp; the pair grid's sequence-axis
+            # sharding comes from the model's shard_pair constraints traced
+            # under the active mesh (parallel/sharding.py)
+            rep = NamedSharding(self.mesh, P())
+            dp = NamedSharding(self.mesh, P(DATA_AXIS))
+            jit_kwargs["in_shardings"] = (rep, dp, dp, dp, dp)
+        ctx = use_mesh(self.mesh) if self.mesh is not None else nullcontext()
         import warnings
 
         t0 = time.perf_counter()
-        with self.tracer.span("serve.compile", bucket=bucket, batch=batch):
+        with self.tracer.span(
+            "serve.compile", bucket=bucket, batch=batch,
+            **({"mesh": self.mesh_desc} if self.mesh_desc else {}),
+        ):
             with warnings.catch_warnings():
                 # feature buffers are int/bool and the outputs are f32
                 # coords, so XLA cannot ALIAS the donation (and says so per
@@ -269,23 +380,36 @@ class ServeEngine:
                 warnings.filterwarnings(
                     "ignore", message="Some donated buffers were not usable"
                 )
-                compiled = (
-                    jax.jit(self._fwd, donate_argnums=donate)
-                    .lower(self.params, *abstract)
-                    .compile()
-                )
+                with ctx:
+                    compiled = (
+                        jax.jit(self._fwd, **jit_kwargs)
+                        .lower(self.params, *abstract)
+                        .compile()
+                    )
         self.counters.bump("serve.compiles")
         costs = executable_costs(compiled)  # flops/bytes via observe.flops
         self._exe_flops[key] = costs["flops"] or 0.0
+        memory = executable_memory(compiled)  # per-device, via observe.flops
         self.compile_records.append({
             "bucket": bucket, "batch": batch,
             "seconds": round(time.perf_counter() - t0, 4),
+            **({"mesh": self.mesh_desc} if self.mesh_desc else {}),
             **({"flops": costs["flops"]} if costs["flops"] else {}),
             **({"bytes_accessed": costs["bytes_accessed"]}
                if costs["bytes_accessed"] else {}),
+            **memory,
         })
         self._executables[key] = compiled
         return compiled
+
+    def _sharded_params(self):
+        """The replicated-on-mesh copy of ``self.params`` every sharded
+        executable consumes (built once, cached)."""
+        if self._mesh_params is None:
+            self._mesh_params = jax.device_put(
+                self.params, NamedSharding(self.mesh, P())
+            )
+        return self._mesh_params
 
     def _abstract_batch(self, bucket: int, batch: int):
         f32 = jax.ShapeDtypeStruct
@@ -319,8 +443,9 @@ class ServeEngine:
         arrival = time.perf_counter()  # queue-wait origin for this stream
         for bucket in sorted(by_bucket):
             order = by_bucket[bucket]
-            for lo in range(0, len(order), self.max_batch):
-                chunk = order[lo : lo + self.max_batch]
+            step = self.batch_for(bucket)
+            for lo in range(0, len(order), step):
+                chunk = order[lo : lo + step]
                 self._dispatch(
                     bucket, [reqs[i] for i in chunk], chunk, results, arrival
                 )
@@ -348,7 +473,14 @@ class ServeEngine:
 
     def _dispatch(self, bucket, chunk_reqs, chunk_idx, results, arrival=None):
         n_real = len(chunk_reqs)
-        batch = self.max_batch if self.cfg.serve.pad_batches else n_real
+        batch = self.batch_for(bucket) if self.cfg.serve.pad_batches else n_real
+        if self.mesh is not None:
+            # the batch axis shards evenly over dp: round partial chunks up
+            # to the next dp multiple with masked dummy slots
+            n_dp = dict(
+                zip(self.mesh.axis_names, self.mesh.devices.shape)
+            ).get(DATA_AXIS, 1)
+            batch += (-batch) % n_dp
         dispatch_index = self.counters.bump("serve.batches")
         self.counters.bump("serve.padded_slots", batch - n_real)
         t_start = time.perf_counter()
@@ -426,10 +558,18 @@ class ServeEngine:
                 # explicit host->device transfer: handing raw numpy to the
                 # executable would be an implicit transfer, which the
                 # transfer-guard test fixtures (tests/conftest.py) and
-                # jax.transfer_guard("disallow") deployments reject
-                stacked = jax.device_put({
-                    k: np.stack([it[k] for it in items]) for k in items[0]
-                })
+                # jax.transfer_guard("disallow") deployments reject. Under
+                # a mesh the transfer carries its sharding explicitly —
+                # batch split over dp at the host boundary, never an
+                # all-replicated copy that GSPMD reshards later.
+                host = {k: np.stack([it[k] for it in items]) for k in items[0]}
+                if self.mesh is not None:
+                    dp = NamedSharding(self.mesh, P(DATA_AXIS))
+                    stacked = {
+                        k: jax.device_put(a, dp) for k, a in host.items()
+                    }
+                else:
+                    stacked = jax.device_put(host)
 
             with self.tracer.span(
                 "serve.get_executable", bucket=bucket, batch=batch
@@ -441,9 +581,16 @@ class ServeEngine:
                 )
 
             t0 = time.perf_counter()
-            with self.tracer.span("serve.dispatch", bucket=bucket):
+            params = (
+                self._sharded_params() if self.mesh is not None
+                else self.params
+            )
+            with self.tracer.span(
+                "serve.dispatch", bucket=bucket,
+                **({"mesh": self.mesh_desc} if self.mesh_desc else {}),
+            ):
                 out = compiled(
-                    self.params, stacked["seq"], stacked["msa"],
+                    params, stacked["seq"], stacked["msa"],
                     stacked["mask"], stacked["msa_mask"],
                 )
             # fetch the values, not just readiness: the timed region must
@@ -458,7 +605,9 @@ class ServeEngine:
             dispatch_s = time.perf_counter() - t0
             batch_span.set(dispatch_s=round(dispatch_s, 4))
             self.histograms["dispatch_s"].observe(dispatch_s)
-            self.executed_flops += self._exe_flops.get((bucket, batch), 0.0)
+            self.executed_flops += self._exe_flops.get(
+                (bucket, batch, self.mesh_desc), 0.0
+            )
             self.memory.counter_to(self.tracer)  # HBM beside the spans
 
             with self.tracer.span("serve.unpad", bucket=bucket):
@@ -489,9 +638,13 @@ class ServeEngine:
         """Compile every ladder rung ahead of traffic (one dummy dispatch
         per bucket). Returns the counter snapshot afterwards."""
         for bucket in self.buckets:
-            self._get_executable(
-                bucket, self.max_batch if self.cfg.serve.pad_batches else 1
-            )
+            batch = self.batch_for(bucket) if self.cfg.serve.pad_batches else 1
+            if self.mesh is not None:  # same dp rounding as _dispatch
+                n_dp = dict(
+                    zip(self.mesh.axis_names, self.mesh.devices.shape)
+                ).get(DATA_AXIS, 1)
+                batch += (-batch) % n_dp
+            self._get_executable(bucket, batch)
         return self.counters.snapshot()
 
     def stats(self) -> dict:

@@ -49,7 +49,7 @@ from typing import Callable, Optional, Union
 
 from alphafold2_tpu.observe import Histogram, Tracer
 from alphafold2_tpu.serve.bucketing import bucket_for
-from alphafold2_tpu.serve.cache import ResultCache
+from alphafold2_tpu.serve.cache import ResultCache, result_key
 from alphafold2_tpu.serve.engine import (
     ServeEngine,
     ServeRequest,
@@ -252,7 +252,10 @@ class AsyncServeFrontend:
             self.tracer.instant("sched.reject", reason="unservable")
             return handle
 
-        key = (req.seq, req.seed)
+        # mesh identity rides in the key (serve/cache.py): results from a
+        # sharded engine and a single-device one are numerically close but
+        # not byte-identical, so they must never dedup onto each other
+        key = result_key(req.seq, req.seed, self.engine.mesh_desc)
         status, payload = self.cache.lookup_or_claim(
             key, follower_ctx=(handle, now)
         )
@@ -357,13 +360,14 @@ class AsyncServeFrontend:
                     q[:] = keep
                     self._depth -= len(dead)
                     expired.extend(dead)
+                fill = self.engine.batch_for(bucket)  # long rungs fill small
                 while q:
-                    ripe = len(q) >= self.engine.max_batch or (
+                    ripe = len(q) >= fill or (
                         now - min(p.enqueued for p in q) >= self.dwell_s
                     )
                     if not ripe:
                         break
-                    take = q[: self.engine.max_batch]
+                    take = q[:fill]
                     del q[: len(take)]
                     self._depth -= len(take)
                     plans.append((bucket, take))
@@ -398,7 +402,12 @@ class AsyncServeFrontend:
             )
         reqs = [p.req for p in pendings]
         t0 = self._clock()
-        with self.tracer.span("sched.dispatch", bucket=bucket, n=len(reqs)):
+        mesh_attr = (
+            {"mesh": self.engine.mesh_desc} if self.engine.mesh_desc else {}
+        )
+        with self.tracer.span(
+            "sched.dispatch", bucket=bucket, n=len(reqs), **mesh_attr
+        ):
             results = self.engine.dispatch_batch(bucket, reqs)
         dt = max(0.0, self._clock() - t0)
         self._ema_dispatch_s = (
@@ -446,10 +455,10 @@ class AsyncServeFrontend:
         """Seconds until the next dwell or deadline expiry (0 = a batch is
         already ripe, None = queue empty: wait for a submit)."""
         horizon = None
-        for q in self._queues.values():
+        for bucket, q in self._queues.items():
             if not q:
                 continue
-            if len(q) >= self.engine.max_batch:
+            if len(q) >= self.engine.batch_for(bucket):
                 return 0.0
             oldest = min(p.enqueued for p in q)
             times = [oldest + self.dwell_s]

@@ -74,6 +74,7 @@ class End2EndModel(nn.Module):
     msa_tie_row_attn: bool = False
     msa_row_shard: bool = False  # shard MSA rows over sp (tied-row psum)
     context_parallel: Optional[str] = None
+    grid_parallel: bool = False  # 2D-sharded pair axial passes (spr x spc)
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -93,6 +94,7 @@ class End2EndModel(nn.Module):
             msa_tie_row_attn=self.msa_tie_row_attn,
             msa_row_shard=self.msa_row_shard,
             context_parallel=self.context_parallel,
+            grid_parallel=self.grid_parallel,
             dtype=self.dtype, name="af2",
         )(seq3, msa, mask=mask3, msa_mask=msa_mask, embedds=embedds,
           deterministic=deterministic)
@@ -288,6 +290,7 @@ def train_end2end(cfg: Config, num_steps: Optional[int] = None, dataset=None):
         msa_tie_row_attn=cfg.model.msa_tie_row_attn,
         msa_row_shard=cfg.model.msa_row_shard,
         context_parallel=cfg.model.context_parallel,
+        grid_parallel=cfg.model.grid_parallel,
         dtype=jnp.bfloat16 if cfg.model.bfloat16 else jnp.float32,
     )
     sample = next(data_iter)

@@ -60,6 +60,17 @@ DEADLINE = _env_int("AF2TPU_BENCH_DEADLINE", 1500)
 # (fail fast, record marked liveness: dead — total < 60s with defaults)
 # vs slow-but-alive (the stage earns another deadline). <= 0 disables.
 INIT_DEADLINE = _env_int("AF2TPU_BENCH_INIT_DEADLINE", 30)
+# the same probe-and-bail for every LATER stage (ROADMAP: a tunnel that
+# dies mid-round used to burn the remaining DEADLINE hung inside a compile
+# or dispatch with nothing on stdout): trace_compile / warmup_run /
+# clock_probe / timed_run (and their serve:* / first_light:* variants via
+# the watchdog's suffix matching) overstaying this trigger the subprocess
+# probe — dead backend => structured failure in stage + probe seconds
+# (default 30 + 25 < the 60 s acceptance bound); slow-but-alive (a long
+# flagship compile — common, compiles are minutes through the tunnel)
+# earns the stage another deadline and the round continues, at the cost
+# of one cheap probe per deadline interval. <= 0 disables.
+STAGE_DEADLINE = _env_int("AF2TPU_BENCH_STAGE_DEADLINE", 30)
 
 
 # ATTEMPTS/DEADLINE/COLD_EXTRA/DRIVER_BUDGET tune retry/timeout infra, not
@@ -71,6 +82,7 @@ _INFRA_KNOBS = {
     "AF2TPU_BENCH_FIRST_LIGHT",  # fallback policy, not a config size
     "AF2TPU_BENCH_MODE",  # train vs serve routing, not a config size
     "AF2TPU_BENCH_INIT_DEADLINE",  # liveness watchdog, not a config size
+    "AF2TPU_BENCH_STAGE_DEADLINE",  # liveness watchdog, not a config size
     "AF2TPU_BENCH_SIMULATE_HANG",  # liveness-test hook, not a config size
 }
 
@@ -459,10 +471,24 @@ def main(overrides: dict | None = None, emit: bool = True,
 # serve baseline.
 _SERVE_INFRA_KNOBS = {"AF2TPU_SERVE_RECORD_BASELINE"}
 
+# the mesh-defining knobs select BETWEEN flagships (single-device vs the
+# sharded serve flagship), they do not size-override one: both the mesh
+# identity and the long-chain ladder ride in the metric label AND the
+# record's mesh key, and the regression gate (observe.regress) refuses any
+# cross-mesh comparison — so records stay self-keyed and safe to compare
+# against their own committed baseline (bench_serve_mesh_baseline.json)
+_SERVE_MESH_KNOBS = {
+    "AF2TPU_SERVE_MESH",
+    "AF2TPU_SERVE_LONG_BUCKETS",
+    "AF2TPU_SERVE_LONG_REQUESTS",
+}
+
 
 def serve_config_overridden() -> bool:
     return any(
-        k.startswith("AF2TPU_SERVE_") and k not in _SERVE_INFRA_KNOBS
+        k.startswith("AF2TPU_SERVE_")
+        and k not in _SERVE_INFRA_KNOBS
+        and k not in _SERVE_MESH_KNOBS
         for k in os.environ
     )
 
@@ -471,33 +497,75 @@ def _serve_sizes() -> dict:
     """The serve-bench flagship config; CPU-mesh sized so tier-1 hosts give
     real (nonzero, clock-honest) numbers — the first valid perf points of
     the trajectory. TPU-scale serving reuses the same engine with bigger
-    AF2TPU_SERVE_* values once the tunnel is back."""
+    AF2TPU_SERVE_* values once the tunnel is back.
+
+    ``AF2TPU_SERVE_MESH`` selects the SECOND flagship — sharded serving
+    over the long-chain ladder: its own (smaller-trunk, 512-bucket)
+    default sizes, its own metric label and its own mesh-keyed committed
+    baseline. Both flagships are fully default-defined; any size env on
+    top marks the record overridden exactly as before."""
+    mesh_spec = os.environ.get("AF2TPU_SERVE_MESH", "")
+    # (single-device flagship default, mesh flagship default)
+    dflt = {
+        "buckets": ("32,48,64", "32,64"),
+        "max_batch": (4, 2),
+        "requests": (24, 8),
+        "dim": (64, 16),
+        "depth": (2, 1),
+        "heads": (4, 1),
+        "dim_head": (16, 8),
+        "msa_depth": (4, 2),
+        "mds_iters": (50, 20),
+        "long_buckets": ("", "512"),
+    }
+    pick = 1 if mesh_spec else 0
+
     buckets = tuple(
         int(v) for v in os.environ.get(
-            "AF2TPU_SERVE_BUCKETS", "32,48,64"
+            "AF2TPU_SERVE_BUCKETS", dflt["buckets"][pick]
+        ).split(",") if v
+    )
+    long_buckets = tuple(
+        int(v) for v in os.environ.get(
+            "AF2TPU_SERVE_LONG_BUCKETS", dflt["long_buckets"][pick]
         ).split(",") if v
     )
     return {
         "buckets": buckets,
-        "max_batch": _env_int("AF2TPU_SERVE_MAX_BATCH", 4),
-        "requests": _env_int("AF2TPU_SERVE_REQUESTS", 24),
-        "dim": _env_int("AF2TPU_SERVE_DIM", 64),
-        "depth": _env_int("AF2TPU_SERVE_DEPTH", 2),
-        "heads": _env_int("AF2TPU_SERVE_HEADS", 4),
-        "dim_head": _env_int("AF2TPU_SERVE_DIM_HEAD", 16),
-        "msa_depth": _env_int("AF2TPU_SERVE_MSA_DEPTH", 4),
-        "mds_iters": _env_int("AF2TPU_SERVE_MDS_ITERS", 50),
+        "max_batch": _env_int("AF2TPU_SERVE_MAX_BATCH", dflt["max_batch"][pick]),
+        "requests": _env_int("AF2TPU_SERVE_REQUESTS", dflt["requests"][pick]),
+        "dim": _env_int("AF2TPU_SERVE_DIM", dflt["dim"][pick]),
+        "depth": _env_int("AF2TPU_SERVE_DEPTH", dflt["depth"][pick]),
+        "heads": _env_int("AF2TPU_SERVE_HEADS", dflt["heads"][pick]),
+        "dim_head": _env_int("AF2TPU_SERVE_DIM_HEAD", dflt["dim_head"][pick]),
+        "msa_depth": _env_int("AF2TPU_SERVE_MSA_DEPTH", dflt["msa_depth"][pick]),
+        "mds_iters": _env_int("AF2TPU_SERVE_MDS_ITERS", dflt["mds_iters"][pick]),
         "seed": _env_int("AF2TPU_SERVE_SEED", 0),
+        # the sharded serve flagship: a mesh spec ("1x2x4" = dp x spr x
+        # spc grid) opens the mesh-gated long-chain rungs and routes the
+        # record to the mesh-keyed baseline
+        "mesh": mesh_spec,
+        "long_buckets": long_buckets,
+        "long_requests": _env_int("AF2TPU_SERVE_LONG_REQUESTS", 1),
     }
 
 
 def _serve_metric(s: dict) -> str:
-    return (
+    label = (
         f"serve residues/sec buckets={','.join(map(str, s['buckets']))} "
         f"max_batch={s['max_batch']} requests={s['requests']} "
         f"dim={s['dim']} depth={s['depth']} msa_depth={s['msa_depth']} "
         f"mds_iters={s['mds_iters']}"
     )
+    if s.get("mesh"):
+        # the sharded flagship is a DIFFERENT metric (and baseline): the
+        # mesh and long-chain workload are part of what is measured
+        label += (
+            f" mesh={s['mesh']} "
+            f"long={','.join(map(str, s['long_buckets'])) or '-'}"
+            f"x{s['long_requests']}"
+        )
+    return label
 
 
 def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
@@ -524,19 +592,29 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
     tracer = tracer if tracer is not None else _tracer()
     s = _serve_sizes()
     with _bench_stage(tracer, "serve:backend_init"):
+        from alphafold2_tpu.parallel.sharding import parse_mesh_spec
+
+        mesh = parse_mesh_spec(s["mesh"])
+        top = (s["long_buckets"] or s["buckets"])[-1]
         cfg = Config(
             model=ModelConfig(
                 dim=s["dim"], depth=s["depth"], heads=s["heads"],
-                dim_head=s["dim_head"], max_seq_len=3 * s["buckets"][-1],
+                dim_head=s["dim_head"], max_seq_len=3 * top,
                 bfloat16=jax.devices()[0].platform != "cpu",
+                # a grid mesh needs the sharded axial primitive (the
+                # engine refuses the combination otherwise)
+                grid_parallel=bool(
+                    mesh is not None and "spr" in mesh.axis_names
+                ),
             ),
             data=DataConfig(msa_depth=s["msa_depth"]),
             serve=ServeConfig(
                 buckets=s["buckets"], max_batch=s["max_batch"],
                 mds_iters=s["mds_iters"],
+                long_buckets=s["long_buckets"] if mesh is not None else (),
             ),
         )
-        engine = ServeEngine(cfg, tracer=tracer)
+        engine = ServeEngine(cfg, tracer=tracer, mesh=mesh)
 
     # deterministic mixed-length request stream spanning the ladder
     rng = np.random.default_rng(s["seed"])
@@ -549,6 +627,16 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
         )
         for i, n in enumerate(lengths)
     ]
+    if mesh is not None and s["long_buckets"]:
+        # the crop-free long-chain workload: requests near the top rung —
+        # lengths a single device REJECTS (the mesh-gated ladder), served
+        # here because the pair grid is sharded O(N^2/(spr*spc)) per device
+        for i in range(s["long_requests"]):
+            n = int(s["long_buckets"][-1] * 0.92) + i
+            reqs.append(ServeRequest(
+                seq="".join(rng.choice(list(alpha), size=n)),
+                seed=len(reqs),
+            ))
 
     with _bench_stage(tracer, "serve:trace_compile"):
         t0 = time.perf_counter()
@@ -596,7 +684,11 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
         "requests": stats.get("serve.requests", 0),
         "batches": stats.get("serve.batches", 0),
         "padding_fraction": round(
-            padding_fraction([len(r.seq) for r in reqs], s["buckets"]), 3
+            padding_fraction(
+                # the engine's effective ladder includes the admitted
+                # long-chain rungs
+                [len(r.seq) for r in reqs], engine.buckets,
+            ), 3,
         ),
         # queue-wait/dispatch breakdown + occupancy/pad distributions
         "histograms": hists,
@@ -604,14 +696,36 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
         "compile_records": engine.compile_records,
         "device": jax.devices()[0].device_kind,
     }
+    if mesh is not None:
+        # mesh-keyed record: the identity string keys the executable
+        # cache, the result cache, the baseline file and the regression
+        # gate's comparability check all at once
+        record["mesh"] = engine.mesh_desc
+        record["mesh_devices"] = int(mesh.devices.size)
+        per_dev = [
+            c["program_bytes"] for c in engine.compile_records
+            if c.get("program_bytes")
+        ]
+        if per_dev:
+            # XLA memory analysis is per device for SPMD programs — the
+            # quantity the pair-grid sharding shrinks, gated vs baseline
+            record["per_device_program_bytes"] = max(per_dev)
     if executed_flops:
         # dispatched model flops over the timed stream (observe.flops)
         record["flops_total"] = executed_flops
-        from alphafold2_tpu.observe.flops import mfu as _mfu
+        if mesh is not None:
+            from alphafold2_tpu.observe.flops import mesh_mfu as _mesh_mfu
 
-        serve_mfu = _mfu(executed_flops, wall)
-        if serve_mfu is not None:
-            record["mfu"] = round(serve_mfu, 4)
+            m = _mesh_mfu(executed_flops, wall, mesh=mesh)
+            if m.get("mfu") is not None:
+                record["mfu"] = round(m["mfu"], 4)
+                record["mfu_basis"] = m["mfu_basis"]
+        else:
+            from alphafold2_tpu.observe.flops import mfu as _mfu
+
+            serve_mfu = _mfu(executed_flops, wall)
+            if serve_mfu is not None:
+                record["mfu"] = round(serve_mfu, 4)
     spans = tracer.span_totals()
     if spans:
         record["spans"] = spans
@@ -625,9 +739,13 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
 
     # the serve trajectory competes against its own committed first record,
     # like the train bench; comparisons require the identical metric label
-    # AND device (a CPU-mesh number vs a TPU number is not a comparison)
+    # AND device AND mesh (a CPU-mesh number vs a TPU number is not a
+    # comparison, nor is a sharded number vs a single-device one) — the
+    # sharded flagship gets its own mesh-keyed baseline file
     baseline_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "bench_serve_baseline.json"
+        os.path.dirname(os.path.abspath(__file__)),
+        "bench_serve_mesh_baseline.json" if mesh is not None
+        else "bench_serve_baseline.json",
     )
     vs, compared = 1.0, False
     if (
@@ -665,7 +783,8 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
             k: v for k, v in record.items()
             if isinstance(v, (int, float, str, bool))
         })
-        MemorySampler().log_to(logger)
+        # mesh runs log per-device HBM peaks (obs_report's mesh section)
+        MemorySampler().log_to(logger, per_device=mesh is not None)
     if owns_tracer:
         tracer.close()
     if emit:
@@ -1119,10 +1238,20 @@ if __name__ == "__main__":
         _emit(rec)
         os._exit(0)
 
+    _stage_deadlines = {}
     if INIT_DEADLINE > 0:
+        _stage_deadlines["backend_init"] = INIT_DEADLINE
+    if STAGE_DEADLINE > 0:
+        # probe-and-bail past backend_init: compile and dispatch phases
+        # get the same dead-tunnel detection (suffix matching covers the
+        # serve:*/serve_async:*/first_light:* variants)
+        for _st in ("trace_compile", "warmup_run", "clock_probe",
+                    "timed_run"):
+            _stage_deadlines[_st] = STAGE_DEADLINE
+    if _stage_deadlines:
         LivenessWatchdog(
             stage_fn=lambda: _PHASE["name"],
-            deadlines={"backend_init": INIT_DEADLINE},
+            deadlines=_stage_deadlines,
             on_dead=_on_liveness_dead,
         ).start()
 

@@ -7,7 +7,9 @@
 ``CURRENT`` is a JSON record as emitted by ``bench.py`` (any mode) —
 a file path, or ``-`` to read the record from stdin (so the bench can pipe
 straight in). ``--baseline`` defaults to the committed baseline for the
-record's mode: ``bench_serve_baseline.json`` for serve records,
+record's mode: ``bench_serve_baseline.json`` for serve records
+(``bench_serve_mesh_baseline.json`` when the record carries a ``mesh``
+key — sharded and single-device baselines coexist),
 ``bench_serve_async_baseline.json`` for serve-async records,
 ``bench_baseline.json`` otherwise. The per-metric threshold table is also
 mode-keyed (``observe.regress.thresholds_for``): serve-async records gate
@@ -60,10 +62,16 @@ def _load_record(path: str) -> dict:
 
 
 def default_baseline_path(record: dict) -> str:
-    name = {
-        "serve": "bench_serve_baseline.json",
-        "serve-async": "bench_serve_async_baseline.json",
-    }.get(record.get("mode"), "bench_baseline.json")
+    if record.get("mode") == "serve" and record.get("mesh"):
+        # mesh-keyed baseline: sharded serve records live beside (never
+        # instead of) the single-device serve baseline, so CPU-mesh and
+        # future TPU-pod numbers coexist behind the same gate
+        name = "bench_serve_mesh_baseline.json"
+    else:
+        name = {
+            "serve": "bench_serve_baseline.json",
+            "serve-async": "bench_serve_async_baseline.json",
+        }.get(record.get("mode"), "bench_baseline.json")
     return os.path.join(REPO, name)
 
 

@@ -197,6 +197,45 @@ def report_scheduler(latest: dict) -> None:
               f"p95 {latest['p95_ms']:.1f}ms  p99 {latest['p99_ms']:.1f}ms")
 
 
+def report_mesh(latest: dict) -> None:
+    """Mesh/sharding section: printed when records carry the mesh key
+    (sharded serving, bench.py --mode serve with AF2TPU_SERVE_MESH).
+    Shows the mesh shape, per-device memory (allocator HBM peaks when the
+    backend exposes them, else the XLA memory-analysis program footprint
+    from the compile records) and per-bucket compile times."""
+    mesh = latest.get("mesh")
+    compile_records = latest.get("compile_records") or []
+    if not mesh and not any(c.get("mesh") for c in compile_records):
+        return
+    print(f"-- mesh sharding ({mesh or 'per-executable'}) --")
+    if latest.get("mesh_devices"):
+        print(f"  devices:        {int(latest['mesh_devices'])}")
+    if latest.get("per_device_program_bytes"):
+        print(
+            "  per-device program footprint: "
+            f"{latest['per_device_program_bytes'] / 2**20:.1f} MiB "
+            "(XLA memory analysis: args + outputs + temps)"
+        )
+    hbm = sorted(
+        (k, v) for k, v in latest.items()
+        if k.startswith("hbm/device") and k.endswith("/peak_bytes")
+    )
+    for key, v in hbm:
+        dev = key.split("/")[1]
+        print(f"  {dev} HBM peak: {v / 2**30:.3f} GiB")
+    if compile_records:
+        print("  per-bucket executables:")
+        for c in compile_records:
+            extra = ""
+            if c.get("program_bytes"):
+                extra = f"  {c['program_bytes'] / 2**20:.1f} MiB/device"
+            print(
+                f"    bucket {c['bucket']:>5} batch {c['batch']} "
+                f"mesh={c.get('mesh') or '-'}: compile "
+                f"{_fmt_s(c['seconds'])}{extra}"
+            )
+
+
 def report_metrics(path: str) -> int:
     records = []
     with open(path) as f:
@@ -211,13 +250,14 @@ def report_metrics(path: str) -> int:
             if k not in ("step", "time"):
                 latest[k] = v
     for k in sorted(latest):
-        # per-tensor numerics stats are summarized by the train section
-        # below, not dumped key by key
-        if not k.startswith("numerics/"):
+        # per-tensor numerics stats and per-device HBM peaks are
+        # summarized by their sections below, not dumped key by key
+        if not k.startswith(("numerics/", "hbm/")):
             print(f"  {k} = {latest[k]}")
 
     report_train(records)
     report_scheduler(latest)
+    report_mesh(latest)
 
     compiles = latest.get("serve.compiles", latest.get("compiles"))
     hits = latest.get("serve.cache_hits", latest.get("cache_hits"))

@@ -145,6 +145,67 @@ def test_cli_default_baseline_routing(bench_compare):
     )
 
 
+# ------------------------------------------------------------ mesh keying
+
+MESH_BASE = {
+    "metric": "serve residues/sec tiny mesh=1x2x4 long=512x1",
+    "device": "cpu", "mode": "serve", "mesh": "dp1.spr2.spc4",
+    "value": 4.0, "p50_ms": 1500.0, "p95_ms": 170000.0, "p99_ms": 170000.0,
+    "per_device_program_bytes": 380_000_000,
+}
+
+
+def test_mesh_records_never_compare_across_meshes():
+    """A sharded record vs a single-device one (or two mesh shapes) is
+    no-data, whatever the device kind says."""
+    v = regress.compare({**MESH_BASE, "mesh": None}, MESH_BASE)
+    assert v["verdict"] == "no-data" and "mesh mismatch" in v["reason"]
+    v = regress.compare({**MESH_BASE, "mesh": "dp1.spr2.spc2"}, MESH_BASE)
+    assert v["verdict"] == "no-data" and "mesh mismatch" in v["reason"]
+
+
+def test_mesh_threshold_selection_and_memory_cliff():
+    """Mesh-serve records select SERVE_MESH_THRESHOLDS: wide cross-machine
+    perf tolerances, but per-device program bytes (deterministic per
+    program) gated at 2x — the forgot-the-sharding cliff."""
+    assert regress.thresholds_for(MESH_BASE) is regress.SERVE_MESH_THRESHOLDS
+    assert regress.thresholds_for(BASE) is regress.DEFAULT_THRESHOLDS
+    ok = regress.compare({**MESH_BASE, "value": 2.0}, MESH_BASE)
+    assert ok["verdict"] == "pass"  # 2x slower machine: inside tolerance
+    cliff = regress.compare(
+        {**MESH_BASE, "per_device_program_bytes": 8 * 380_000_000},
+        MESH_BASE,
+    )
+    assert cliff["verdict"] == "regress"
+    assert cliff["regressions"] == ["per_device_program_bytes"]
+
+
+def test_cli_mesh_baseline_routing(bench_compare):
+    assert bench_compare.default_baseline_path(
+        {"mode": "serve", "mesh": "dp1.spr2.spc4"}
+    ).endswith("bench_serve_mesh_baseline.json")
+    assert bench_compare.default_baseline_path({"mode": "serve"}).endswith(
+        "bench_serve_baseline.json"
+    )
+
+
+def test_committed_mesh_baseline_is_valid_and_self_consistent():
+    """The committed mesh-keyed baseline must be a usable measurement
+    (regress validity taxonomy) carrying the acceptance fields: mesh
+    shape, per-device memory, and MFU accounting."""
+    with open(os.path.join(REPO, "bench_serve_mesh_baseline.json")) as f:
+        base = json.load(f)
+    assert regress.record_invalid_reason(base) is None
+    assert base["mesh"] == "dp1.spr2.spc4" and base["mesh_devices"] == 8
+    assert base["per_device_program_bytes"] > 0
+    assert base["mfu"] is not None and base["mfu_basis"]
+    assert any(
+        c["bucket"] >= 512 and c.get("mesh") for c in base["compile_records"]
+    )
+    v = regress.compare(base, base, regress.thresholds_for(base))
+    assert v["verdict"] == "pass"
+
+
 # -------------------------------------------------- serve-async thresholds
 
 ASYNC_BASE = {

@@ -55,10 +55,52 @@ def test_bench_emits_liveness_dead_record_fast():
     assert "probe hung" in record["probe"]
 
 
+def test_bench_compile_phase_dead_tunnel_fails_fast():
+    """ROADMAP satellite: the probe-and-bail must cover phases PAST
+    backend_init — a tunnel that dies mid-compile used to burn the whole
+    remaining deadline. Simulated hang inside serve:trace_compile +
+    hanging probe => structured liveness-dead record in seconds."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        AF2TPU_PLATFORM="cpu",
+        AF2TPU_BENCH_MODE="serve",
+        AF2TPU_SERVE_BUCKETS="8",
+        AF2TPU_SERVE_REQUESTS="2",
+        AF2TPU_SERVE_DIM="32",
+        AF2TPU_SERVE_DEPTH="1",
+        AF2TPU_SERVE_HEADS="2",
+        AF2TPU_SERVE_DIM_HEAD="16",
+        AF2TPU_SERVE_MSA_DEPTH="2",
+        AF2TPU_SERVE_MDS_ITERS="8",
+        # hang INSIDE the compile phase, past a healthy backend_init
+        AF2TPU_BENCH_SIMULATE_HANG="trace_compile:300",
+        AF2TPU_BENCH_STAGE_DEADLINE="2",
+        AF2TPU_LIVENESS_TIMEOUT="3",
+        AF2TPU_LIVENESS_PROBE_CODE="import time; time.sleep(120)",
+    )
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=110, env=env,
+    )
+    elapsed = time.monotonic() - t0
+    assert elapsed < 110, elapsed
+
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, (r.stdout, r.stderr[-1000:])
+    record = json.loads(lines[0])
+    assert record["liveness"] == "dead"
+    assert record["stage"] == "serve:trace_compile"
+    assert record["value"] == 0.0
+    assert "probe hung" in record["probe"]
+
+
 def test_default_deadlines_fit_the_60s_bound():
     """The production path is stage deadline + probe timeout (+ poll/emit
     overhead); the defaults must leave margin under the 60 s acceptance
-    bound so a real dead tunnel also fails fast."""
+    bound so a real dead tunnel also fails fast — in EVERY probed phase,
+    not just backend_init."""
     sys.path.insert(0, REPO)
     import importlib
 
@@ -67,6 +109,7 @@ def test_default_deadlines_fit_the_60s_bound():
     importlib.reload(bench)
     probe_timeout = float(os.environ.get("AF2TPU_LIVENESS_TIMEOUT", 25))
     assert bench.INIT_DEADLINE + probe_timeout <= 58
+    assert bench.STAGE_DEADLINE + probe_timeout <= 58
 
 
 def test_live_backend_is_not_killed(monkeypatch):

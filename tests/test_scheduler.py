@@ -60,10 +60,14 @@ class FakeEngine:
         self.cfg = cfg
         self.buckets = cfg.serve.buckets
         self.max_batch = cfg.serve.max_batch
+        self.mesh_desc = None  # single-device stand-in (no mesh identity)
         self.counters = EventCounters()
         self.tracer = Tracer(enabled=False)
         self.dispatched = []  # (bucket, [seq, ...]) per dispatch
         self._fail_remaining = fail_first
+
+    def batch_for(self, bucket):
+        return self.max_batch
 
     def dispatch_batch(self, bucket, reqs):
         self.dispatched.append((bucket, [r.seq for r in reqs]))
