@@ -180,6 +180,80 @@ def case_flash_bwd_256():
     lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
 
 
+def case_fused_axial_fwd(n=256):
+    """The in-repo fused dense attention kernel (ops/pallas/axial.py) at
+    the axial-pass shape, compiled-mode Mosaic lowering with a padding
+    mask (the bias-streaming layout is what tiling checks bite on)."""
+    from alphafold2_tpu.ops.pallas.axial import fused_attention
+
+    b, h, d = 2, 4, 64
+    k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(k1, (b, h, n, d), jnp.float32)
+    k = jax.random.normal(k2, (b, h, n, d), jnp.float32)
+    v = jax.random.normal(k3, (b, h, n, d), jnp.float32)
+    mask = jnp.ones((b, n), bool).at[:, -17:].set(False)
+
+    def f(q, k, v):
+        return fused_attention(
+            q, k, v, q_mask=mask, kv_mask=mask, sm_scale=d**-0.5,
+            interpret=False,
+        )
+
+    lower_for_tpu(f, q, k, v)
+
+
+def case_fused_axial_bwd(n=256):
+    """Gradients through the fused kernel's custom VJP: lowers the dq and
+    dk/dv kernels inside one traced program."""
+    from alphafold2_tpu.ops.pallas.axial import fused_attention
+
+    b, h, d = 2, 4, 64
+    q = jnp.ones((b, h, n, d), jnp.float32)
+    mask = jnp.ones((b, n), bool).at[:, -17:].set(False)
+
+    def loss(q, k, v):
+        o = fused_attention(
+            q, k, v, kv_mask=mask, sm_scale=d**-0.5, interpret=False
+        )
+        return jnp.sum(o * o)
+
+    lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+
+
+def case_tied_row_fwd(n=256):
+    """The fused tied-row MSA kernel at trunk shape: fused feature axis
+    R*D = 512 exercises the wide-accumulator tiling."""
+    from alphafold2_tpu.ops.pallas.tied_row import tied_row_attention
+
+    b, r, h, d = 1, 8, 4, 64
+    q = jnp.ones((b, r, n, h, d), jnp.float32)
+    mask = jnp.ones((b, n), bool).at[:, -9:].set(False)
+
+    def f(q, k, v):
+        return tied_row_attention(
+            q, k, v, q_mask=mask, kv_mask=mask, sm_scale=d**-0.5,
+            interpret=False,
+        )
+
+    lower_for_tpu(f, q, q, q)
+
+
+def case_tied_row_bwd(n=256):
+    from alphafold2_tpu.ops.pallas.tied_row import tied_row_attention
+
+    b, r, h, d = 1, 8, 4, 64
+    q = jnp.ones((b, r, n, h, d), jnp.float32)
+    mask = jnp.ones((b, n), bool).at[:, -9:].set(False)
+
+    def loss(q, k, v):
+        o = tied_row_attention(
+            q, k, v, kv_mask=mask, sm_scale=d**-0.5, interpret=False
+        )
+        return jnp.sum(o * o)
+
+    lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+
+
 def case_negative_control():
     """The round-4 bug class, reconstructed: a (1, block) row-stat output
     block on a (rows, n) array. The gate MUST reject it — if this lowers,
@@ -256,6 +330,10 @@ CASES = [
     ("flash_axial_256", case_flash_axial_256),
     ("flash_compressed_cross", case_flash_compressed_cross),
     ("flash_bwd_256", case_flash_bwd_256),
+    ("fused_axial_fwd_256", case_fused_axial_fwd),
+    ("fused_axial_bwd_256", case_fused_axial_bwd),
+    ("tied_row_fwd_256", case_tied_row_fwd),
+    ("tied_row_bwd_256", case_tied_row_bwd),
     ("negative_control_rejects_bad_tiling", case_negative_control),
 ]
 

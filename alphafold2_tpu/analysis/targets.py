@@ -220,9 +220,96 @@ def _build_serve_fwd_grid():
     return fwd, (params, seq, msa, mask, msa_mask)
 
 
+def _build_serve_fwd_bf16():
+    """The serve engine's _fwd in the bf16 serving mode (serve.dtype=
+    "bfloat16"): bf16-cast params + bf16 compute dtype, exactly what
+    ServeEngine builds. A DISTINCT fingerprint target — flipping the
+    serving precision must surface as an explicit contract diff (new
+    convert_element_type mix, bf16 input signature), never as a silent
+    mutation of the f32 serve_fwd contract."""
+    import jax
+    import jax.numpy as jnp
+
+    from alphafold2_tpu.train.end2end import End2EndModel
+
+    bucket, batch, depth = 8, 2, 2
+    model = End2EndModel(
+        dim=32, depth=1, heads=2, dim_head=16, max_seq_len=3 * bucket,
+        mds_iters=8, mds_per_position_init=True, msa_tie_row_attn=True,
+        dtype=jnp.bfloat16,
+    )
+    seq = jnp.zeros((batch, bucket), jnp.int32)
+    msa = jnp.zeros((batch, depth, bucket), jnp.int32)
+    mask = jnp.ones((batch, bucket), bool)
+    msa_mask = jnp.ones((batch, depth, bucket), bool)
+    params = model.init(jax.random.key(0), seq, msa, mask=mask,
+                        msa_mask=msa_mask)
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if getattr(x, "dtype", None) == jnp.float32 else x,
+        params,
+    )
+    mds_key = jax.random.key(0)
+
+    def fwd(params, seq, msa, mask, msa_mask):
+        out = model.apply(
+            params, seq, msa, mask=mask, msa_mask=msa_mask,
+            mds_key=mds_key, deterministic=True,
+        )
+        return {"refined": out["refined"], "weights": out["weights"]}
+
+    return fwd, (params, seq, msa, mask, msa_mask)
+
+
+def _build_attn_tied_row_pallas():
+    """The fused tied-row kernel's graph at a tiny shape (interpret=True so
+    the fingerprint is backend-independent): pins the pallas_call + fold
+    relayouts so kernel plumbing changes are reviewed diffs."""
+    import jax
+    import jax.numpy as jnp
+
+    from alphafold2_tpu.ops.pallas.tied_row import tied_row_attention
+
+    b, r, n, h, d = 1, 2, 16, 2, 8
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (b, r, n, h, d), jnp.float32)
+    mask = jnp.ones((b, n), bool)
+
+    def fwd(q, k, v):
+        return tied_row_attention(
+            q, k, v, q_mask=mask, kv_mask=mask, sm_scale=d**-0.5,
+            interpret=True,
+        )
+
+    return fwd, (q, q, q)
+
+
+def _build_attn_axial_pallas():
+    """The fused axial kernel's graph (forward + backward through the
+    custom VJP) at a tiny shape, interpret=True."""
+    import jax
+    import jax.numpy as jnp
+
+    from alphafold2_tpu.ops.pallas.axial import fused_attention
+
+    b, h, n, d = 1, 2, 16, 8
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (b, h, n, d), jnp.float32)
+    mask = jnp.ones((b, n), bool)
+
+    def loss(q, k, v):
+        out = fused_attention(
+            q, k, v, kv_mask=mask, sm_scale=d**-0.5, interpret=True
+        )
+        return jnp.sum(out * out)
+
+    return jax.grad(loss, argnums=(0, 1, 2)), (q, q, q)
+
+
 def default_targets() -> list:
     """The audited surface: model forward, train step, serve forward
-    (single-device and grid-mesh-sharded)."""
+    (single-device, grid-mesh-sharded, and bf16), and the fused Pallas
+    kernel graphs."""
     return [
         TraceTarget(name="model_fwd", build=_build_model_fwd),
         TraceTarget(
@@ -269,6 +356,34 @@ def default_targets() -> list:
                     "it device_put with explicit shardings"
                 ),
             },
+        ),
+        TraceTarget(
+            name="serve_fwd_bf16",
+            build=_build_serve_fwd_bf16,
+            donate_argnums=(1, 2, 3, 4),
+            allow=frozenset({"AF2A104", "AF2A105"}),
+            allow_reasons={
+                "AF2A104": (
+                    "same early-free donation intent as serve_fwd: the "
+                    "bf16 engine donates the int/bool feature buffers"
+                ),
+                "AF2A105": (
+                    "flax's LayerNorm._compute_stats promotes bf16 inputs "
+                    "with float32 for the mean/variance reduction — an "
+                    "upstream (and numerically desirable) promotion this "
+                    "repo cannot spell explicitly; the f32 serve_fwd "
+                    "target keeps strict promotion enforced on the same "
+                    "graph at full precision"
+                ),
+            },
+        ),
+        TraceTarget(
+            name="attn_tied_row_pallas",
+            build=_build_attn_tied_row_pallas,
+        ),
+        TraceTarget(
+            name="attn_axial_pallas",
+            build=_build_attn_axial_pallas,
         ),
     ]
 

@@ -112,6 +112,17 @@ class ServeConfig:
     pad_batches: bool = True
     msa_depth: int = 0  # synthesized MSA rows per request; 0 -> data.msa_depth
     mds_iters: int = 200  # structure-realization Guttman iterations
+    # serving precision: "float32" (default — model.bfloat16 still governs
+    # the TPU compute dtype exactly as before) | "bfloat16" (params cast to
+    # bf16 at engine build + bf16 compute; numerically gated by the drift
+    # bounds tests/test_precision.py pins, and fingerprinted as distinct
+    # graph-contract targets so precision changes are explicit diffs)
+    dtype: str = "float32"
+    # kernel policy spec (ops/kernels.py KernelPolicy), e.g.
+    # "tied_row=pallas,axial=pallas"; "" = the process default
+    # (AF2TPU_KERNELS env var, all-auto when unset). The resolved identity
+    # keys the engine's executable cache, compile records and bench records.
+    kernels: str = ""
     donate_buffers: bool = True  # donate per-request feature buffers to XLA
     return_distogram: bool = False  # ship (3L,3L,K) logits back per request
     # --- async frontend (serve/scheduler.py: AsyncServeFrontend) ---

@@ -122,6 +122,54 @@ def estimate_mfu(compiled, step_seconds: float) -> Optional[float]:
     return mfu(step_flops(compiled), step_seconds)
 
 
+def attention_flops_attribution(
+    *,
+    batch: int,
+    pair_len: int,
+    msa_depth: int,
+    msa_len: int,
+    depth: int,
+    heads: int,
+    dim_head: int,
+    tie_rows: bool = False,
+    total_flops: Optional[float] = None,
+) -> dict:
+    """Per-kernel attribution of one trunk forward's attention FLOPs.
+
+    XLA's ``cost_analysis`` reports one number for the whole executable;
+    when MFU moves, nothing says WHICH attention shape is responsible. This
+    is the analytical split (matmul FLOPs only, 2 flops per MAC, QK^T + AV
+    per pass) over the trunk's attention families at the engine's static
+    shapes — the same quantities the fused kernels target:
+
+    - ``axial``: the two axial passes per layer over the (pair_len,
+      pair_len) pair grid — 2 * 4 * B * N^3 * inner per layer, the N^2
+      hot path.
+    - ``tied_row``: the MSA row pass when rows are tied (the tied-row
+      kernel's shape) — 4 * B * M * Nm^2 * inner per layer; attributed to
+      ``msa_axial_untied`` instead when ``tie_rows`` is False.
+    - ``msa_axial_untied``: the remaining MSA axial work (column pass, and
+      the row pass when untied).
+    - ``other``: ``total_flops`` minus the attention families (cross-attn,
+      feedforwards, embeddings, realization) when a total is given.
+
+    Shapes follow the serve engine's geometry: ``pair_len`` is the
+    elongated token length (3 * bucket), ``msa_len`` the unelongated
+    bucket. Purely analytical — never touches a backend."""
+    inner = heads * dim_head
+    axial = depth * 2 * 4.0 * batch * float(pair_len) ** 3 * inner
+    msa_row = depth * 4.0 * batch * msa_depth * float(msa_len) ** 2 * inner
+    msa_col = depth * 4.0 * batch * msa_len * float(msa_depth) ** 2 * inner
+    out = {
+        "axial": axial,
+        "tied_row": msa_row if tie_rows else 0.0,
+        "msa_axial_untied": (0.0 if tie_rows else msa_row) + msa_col,
+    }
+    if total_flops:
+        out["other"] = max(0.0, float(total_flops) - sum(out.values()))
+    return out
+
+
 # one measured-peak probe per process (keyed by device kind)
 _CALIBRATED: dict = {}
 

@@ -74,12 +74,27 @@ SERVE_MESH_THRESHOLDS = {
     "per_device_program_bytes": ("lower", 1.00),
 }
 
+# kernels microbench (bench.py --mode kernels): fused-vs-stock attention
+# timings at fixed shapes. The headline is the geomean speedup (on CPU the
+# fused kernels run in Pallas interpret mode, so the committed CPU baseline
+# sits well below 1x — the gate watches for CLIFFS in that ratio, e.g. an
+# interpret-path blowup or a kernel suddenly falling back to dense, not for
+# absolute speed). Wide tolerances: single-shape microbenches on shared CI
+# runners are the noisiest records in the tree.
+KERNELS_THRESHOLDS = {
+    "value": ("higher", 0.50),
+    "fused_ms_total": ("lower", 1.50),
+    "stock_ms_total": ("lower", 1.50),
+}
+
 
 def thresholds_for(record) -> dict:
     """The gate's per-metric direction/tolerance table for this record's
     shape (keyed by the record's ``mode`` and mesh identity)."""
     if isinstance(record, dict) and record.get("mode") == "serve-async":
         return SERVE_ASYNC_THRESHOLDS
+    if isinstance(record, dict) and record.get("mode") == "kernels":
+        return KERNELS_THRESHOLDS
     if isinstance(record, dict) and record.get("mesh"):
         return SERVE_MESH_THRESHOLDS
     return DEFAULT_THRESHOLDS
@@ -118,14 +133,18 @@ def comparable_reason(current: dict, baseline: dict) -> Optional[str]:
     cur_dev, base_dev = current.get("device"), baseline.get("device")
     if cur_dev and base_dev and cur_dev != base_dev:
         return f"device mismatch: current={cur_dev!r} baseline={base_dev!r}"
-    if current.get("mesh") != baseline.get("mesh"):
-        # records grew a mesh key (sharded serving): a sharded number vs a
-        # single-device one — or two different mesh shapes — is not a
-        # comparison even when the device kind matches
-        return (
-            f"mesh mismatch: current={current.get('mesh')!r} "
-            f"baseline={baseline.get('mesh')!r}"
-        )
+    # variant keys records carry only when non-default: mesh identity
+    # (sharded serving), serving dtype (bf16 mode) and kernel policy
+    # (fused Pallas selection). A sharded vs single-device number, a bf16
+    # vs f32 one, or two different kernel selections are not comparisons —
+    # precision/kernel changes must surface as explicit no-data diffs (and
+    # their own baselines), never as silent ratio drift.
+    for key in ("mesh", "dtype", "kernels"):
+        if current.get(key) != baseline.get(key):
+            return (
+                f"{key} mismatch: current={current.get(key)!r} "
+                f"baseline={baseline.get(key)!r}"
+            )
     if "ingraph" in baseline and baseline.get("ingraph") != current.get(
         "ingraph"
     ):

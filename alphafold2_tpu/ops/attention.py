@@ -139,11 +139,13 @@ class Attention(nn.Module):
 
     def _use_flash(self) -> bool:
         """One place for the None -> auto-on-TPU flash policy (both the flat
-        __call__ path and grid_axial consult it)."""
+        __call__ path and grid_axial consult it). The explicit module-level
+        bool wins; the None case defers to the KernelPolicy switchboard
+        (ops/kernels.py — AF2TPU_KERNELS / ServeConfig.kernels)."""
         if self.use_flash is None:
-            from alphafold2_tpu.ops.flash import flash_available
+            from alphafold2_tpu.ops.kernels import resolve_flash
 
-            return flash_available()
+            return resolve_flash()
         return self.use_flash
 
     def grid_axial(self, x, mask=None, attend_axis: int = 2,
@@ -157,7 +159,19 @@ class Attention(nn.Module):
         exact dense attention); no tied rows / compression / broadcast
         context here."""
         dh = self.dim_head
-        if self._use_flash():
+        from alphafold2_tpu.ops.kernels import resolve_axial
+
+        impl = resolve_axial()
+        if impl == "pallas":
+            # the in-repo fused kernel (ops/pallas/axial.py): compiled on
+            # TPU, interpret-mode (exact, slow) elsewhere — selected only
+            # by explicit KernelPolicy, never silently
+            from alphafold2_tpu.ops.pallas.axial import axial_attn_fn
+
+            attn_fn = axial_attn_fn(dh**-0.5)
+        elif impl == "dense":
+            attn_fn = None  # debug escape: plain per-device dense attention
+        elif self._use_flash():
             from alphafold2_tpu.ops.flash import flash_attention
 
             def attn_fn(q2, k2, v2, m2):
@@ -351,6 +365,31 @@ class Attention(nn.Module):
                 # shared masks for the softmax below (batch dim B, not B*R)
                 mask = qr.any(1)
                 context_mask = kr.any(1) if has_context else None
+
+            # fused tied-row kernel (ops/pallas/tied_row.py, selected by
+            # the KernelPolicy switchboard): the shared (B, H, n, j) logits
+            # stay in VMEM via the fused (row, head_dim) contraction; the
+            # abstention masking and voting-row tie scale above are already
+            # applied, so the kernel sees exactly the dense inputs. Active
+            # attention-weight dropout keeps the dense path (it needs
+            # materialized probabilities).
+            from alphafold2_tpu.ops.kernels import resolve_tied_row
+
+            if resolve_tied_row() == "pallas" and (
+                self.dropout == 0.0 or deterministic
+            ):
+                from alphafold2_tpu.ops.pallas.tied_row import (
+                    tied_row_attention,
+                )
+
+                km = context_mask if has_context else mask
+                out = tied_row_attention(
+                    q, k, v, q_mask=mask, kv_mask=km,
+                    sm_scale=scale, tie_scale=tie_scale,
+                )  # (B, R, n, h, dh)
+                out = out.reshape(-1, *out.shape[2:])
+                out = out.reshape(*out.shape[:-2], inner)
+                return self.to_out(out)
             dots = jnp.einsum("brihd,brjhd->bhij", q, k) * scale * tie_scale
         else:
             dots = jnp.einsum("bihd,bjhd->bhij", q, k) * scale

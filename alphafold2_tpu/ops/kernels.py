@@ -1,0 +1,197 @@
+"""KernelPolicy: one switchboard for every attention kernel in the tree.
+
+Before this module each kernel had its own ad-hoc gate — the stock flash
+wrapper keyed on ``use_flash``/backend, the block-sparse Pallas kernel on
+``SparseAttention.use_pallas``/``config.backend``, and the new fused
+tied-row/axial kernels would have added a third convention. Now ONE policy
+object answers "which implementation serves this attention shape", selected
+per process (``AF2TPU_KERNELS``), per engine (``ServeConfig.kernels``) or
+per trace (:func:`use_kernel_policy`), and its identity threads into serve
+compile records, bench records and the regression gate's comparability
+check — a kernel change is a visible key, never silent drift.
+
+Policy fields and choices (every field defaults to ``"auto"``):
+
+- ``tied_row``: ``auto`` | ``pallas`` | ``dense`` — the tied-row MSA
+  attention path in ``Attention.__call__``. ``auto`` = the fused Pallas
+  kernel (ops/pallas/tied_row.py) on TPU backends, dense einsum elsewhere.
+- ``axial``: ``auto`` | ``pallas`` | ``stock`` | ``dense`` — the per-device
+  attended-axis pass of the grid-native axial attention
+  (``Attention.grid_axial`` / ``AxialAttention``). ``auto`` keeps the
+  proven chain (stock jax flash kernel on TPU, chunked/dense off-TPU);
+  ``pallas`` selects the in-repo fused kernel (ops/pallas/axial.py) —
+  compiled on TPU, interpret-mode elsewhere.
+- ``flash``: ``auto`` | ``on`` | ``off`` — the stock-kernel fast path for
+  the flat dense/cross attention in ``Attention.__call__`` (the existing
+  ``use_flash=None`` auto policy; an explicit module-level ``use_flash``
+  bool still wins for back-compat).
+- ``block_sparse``: ``auto`` | ``pallas`` | ``jnp`` | ``splash`` — the
+  ``SparseAttention`` backend. Explicit ``use_pallas`` bools and a
+  non-"auto" ``BlockSparseConfig.backend`` still win (they are reviewed
+  per-module choices); the policy refines the remaining auto case.
+
+Spec syntax (env var and ``ServeConfig.kernels``)::
+
+    AF2TPU_KERNELS="tied_row=pallas,axial=pallas"
+    AF2TPU_KERNELS="flash=off,block_sparse=jnp"
+
+Consulted at TRACE time only — like ``parallel.sharding.active_mesh``, the
+policy is part of the program being built, so engines activate it around
+``.lower()`` and bake the resolved description into the executable's cache
+key and compile record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+_CHOICES = {
+    "tied_row": ("auto", "pallas", "dense"),
+    "axial": ("auto", "pallas", "stock", "dense"),
+    "flash": ("auto", "on", "off"),
+    "block_sparse": ("auto", "pallas", "jnp", "splash"),
+}
+
+ENV_VAR = "AF2TPU_KERNELS"
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelPolicy:
+    """Which implementation serves each attention shape (see module doc)."""
+
+    tied_row: str = "auto"
+    axial: str = "auto"
+    flash: str = "auto"
+    block_sparse: str = "auto"
+
+    def __post_init__(self):
+        for field, choices in _CHOICES.items():
+            value = getattr(self, field)
+            if value not in choices:
+                raise ValueError(
+                    f"kernel policy {field}={value!r}; choices: {choices}"
+                )
+
+    def describe(self) -> str:
+        """Stable short identity for records/keys: the non-default fields
+        as ``field=value`` comma-joined, or ``"auto"`` when fully default —
+        mirrors ``describe_mesh``'s empty-when-absent convention so records
+        without any policy override stay comparable to old baselines."""
+        parts = [
+            f"{f}={getattr(self, f)}"
+            for f in _CHOICES
+            if getattr(self, f) != "auto"
+        ]
+        return ",".join(parts) if parts else "auto"
+
+
+def parse_policy(spec: Optional[str]) -> KernelPolicy:
+    """``"tied_row=pallas,axial=dense"`` -> KernelPolicy. Empty/None/"auto"
+    -> the all-auto policy. Unknown fields or values raise (a typo'd kernel
+    selection must be loud, not a silent fallback to stock XLA)."""
+    if not spec or spec.strip() == "auto":
+        return KernelPolicy()
+    fields: dict = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, sep, value = item.partition("=")
+        if not sep or name not in _CHOICES:
+            raise ValueError(
+                f"bad kernel policy entry {item!r} in {spec!r}; known "
+                f"fields: {sorted(_CHOICES)}"
+            )
+        fields[name] = value.strip()
+    return KernelPolicy(**fields)
+
+
+class _ThreadState(threading.local):
+    policy: Optional[KernelPolicy] = None
+
+
+_STATE = _ThreadState()
+_ENV_CACHE: dict = {}
+
+
+def policy_from_env() -> KernelPolicy:
+    spec = os.environ.get(ENV_VAR, "")
+    hit = _ENV_CACHE.get(spec)
+    if hit is None:
+        hit = _ENV_CACHE[spec] = parse_policy(spec)
+    return hit
+
+
+def current_policy() -> KernelPolicy:
+    """The active policy on this (tracing) thread: an explicit
+    :func:`use_kernel_policy` context wins, else the process-wide
+    ``AF2TPU_KERNELS`` env policy (all-auto when unset)."""
+    pol = _STATE.policy
+    return pol if pol is not None else policy_from_env()
+
+
+@contextmanager
+def use_kernel_policy(policy: Optional[KernelPolicy]):
+    """Activate ``policy`` for traces on this thread (None = no-op). The
+    serve engine wraps its AOT ``.lower()`` in this so per-engine kernel
+    choice composes with the env default."""
+    if policy is None:
+        yield
+        return
+    prev = _STATE.policy
+    _STATE.policy = policy
+    try:
+        yield
+    finally:
+        _STATE.policy = prev
+
+
+# ------------------------------------------------------------- resolution
+
+
+def resolve_tied_row(policy: Optional[KernelPolicy] = None) -> str:
+    """"pallas" | "dense" for the tied-row MSA path. auto -> the fused
+    kernel on TPU (the trunk hot path this policy exists to fuse), dense
+    elsewhere (the CPU-mesh serve/train graphs — and their committed
+    contract fingerprints — stay byte-identical unless opted in)."""
+    import jax
+
+    choice = (policy or current_policy()).tied_row
+    if choice == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "dense"
+    return choice
+
+
+def resolve_axial(policy: Optional[KernelPolicy] = None) -> str:
+    """"pallas" | "stock" | "dense" for the grid-axial per-device pass.
+    auto -> "stock" (the existing flash-on-TPU / chunked-off-TPU chain);
+    "pallas" opts into the in-repo fused kernel."""
+    choice = (policy or current_policy()).axial
+    return "stock" if choice == "auto" else choice
+
+
+def resolve_flash(policy: Optional[KernelPolicy] = None) -> bool:
+    """Whether the flat dense paths may try the stock flash kernel (the
+    ``use_flash=None`` auto case). "on" still requires a TPU backend —
+    the wrapper declines and falls back off-TPU exactly as before."""
+    from alphafold2_tpu.ops.flash import flash_available
+
+    choice = (policy or current_policy()).flash
+    if choice == "off":
+        return False
+    return flash_available()
+
+
+def resolve_block_sparse(policy: Optional[KernelPolicy] = None) -> str:
+    """"pallas" | "jnp" | "splash" for SparseAttention's remaining auto
+    case (explicit use_pallas / config.backend win upstream of this)."""
+    import jax
+
+    choice = (policy or current_policy()).block_sparse
+    if choice == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "jnp"
+    return choice

@@ -372,8 +372,11 @@ class SparseAttention(nn.Module):
 
     def _impl(self):
         backend = getattr(self.config, "backend", "auto")
-        # the explicit use_pallas bool predates config.backend and wins for
-        # back-compat; config.backend refines the default ("auto") policy
+        # precedence: the explicit use_pallas bool (predates config.backend,
+        # wins for back-compat) > a non-"auto" config.backend (a reviewed
+        # per-module choice) > the KernelPolicy switchboard (ops/kernels.py
+        # — one env var / ServeConfig field selects every kernel in the
+        # tree consistently, and its identity rides in serve records)
         impls = {
             "jnp": block_sparse_attention,
             "pallas": block_sparse_attention_pallas,
@@ -384,16 +387,17 @@ class SparseAttention(nn.Module):
                 f"unknown sparse backend {backend!r}; have "
                 f"{['auto', *impls]}"
             )
-        if self.use_pallas is None and backend != "auto":
+        if self.use_pallas is not None:
+            return (
+                block_sparse_attention_pallas
+                if self.use_pallas
+                else block_sparse_attention
+            )
+        if backend != "auto":
             return impls[backend]
-        use_pallas = self.use_pallas
-        if use_pallas is None:
-            use_pallas = jax.default_backend() == "tpu"
-        return (
-            block_sparse_attention_pallas
-            if use_pallas
-            else block_sparse_attention
-        )
+        from alphafold2_tpu.ops.kernels import resolve_block_sparse
+
+        return impls[resolve_block_sparse()]
 
     def grid_axial(self, x, mask=None, attend_axis: int = 2,
                    sharded: bool = True):

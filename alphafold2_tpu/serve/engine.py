@@ -50,7 +50,11 @@ from alphafold2_tpu.observe import (
     MemorySampler,
     Tracer,
 )
-from alphafold2_tpu.observe.flops import executable_costs, executable_memory
+from alphafold2_tpu.observe.flops import (
+    attention_flops_attribution,
+    executable_costs,
+    executable_memory,
+)
 from alphafold2_tpu.parallel.sharding import (
     DATA_AXIS,
     describe_mesh,
@@ -206,6 +210,28 @@ class ServeEngine:
                 f"serve msa_depth={self.msa_depth} exceeds MAX_NUM_MSA="
                 f"{constants.MAX_NUM_MSA}"
             )
+        # serving precision mode: "bfloat16" casts params at build (below)
+        # and switches the compute dtype; proven against stated per-layer
+        # drift bounds in tests/test_precision.py, fingerprinted as its own
+        # graph-contract target (analysis/targets.py serve_fwd_bf16)
+        self.serve_dtype = str(cfg.serve.dtype or "float32")
+        if self.serve_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"serve.dtype must be 'float32' or 'bfloat16', got "
+                f"{self.serve_dtype!r}"
+            )
+        # kernel policy (ops/kernels.py): a per-engine spec wins over the
+        # process default; the RESOLVED identity keys every executable this
+        # engine builds (cache key, compile records, bench records)
+        from alphafold2_tpu.ops.kernels import current_policy, parse_policy
+
+        self.kernel_policy = (
+            parse_policy(cfg.serve.kernels) if cfg.serve.kernels else None
+        )
+        self.kernels_desc = (
+            self.kernel_policy if self.kernel_policy is not None
+            else current_policy()
+        ).describe()
         self.counters = counters if counters is not None else EventCounters()
         self.tracer = tracer if tracer is not None else Tracer(enabled=False)
         self.memory = MemorySampler()
@@ -218,9 +244,19 @@ class ServeEngine:
         }
         self.compile_records: list = []
         # flops of every executed dispatch (observe.flops cost analysis of
-        # the executable that carried it): the serve bench's MFU numerator
+        # the executable that carried it): the serve bench's MFU numerator.
+        # The breakdown accumulates the analytical per-kernel attribution
+        # (tied-row vs axial vs rest) so MFU deltas name the kernel.
         self.executed_flops: float = 0.0
+        self.executed_flops_breakdown: dict = {}
         self._exe_flops: dict = {}
+        self._exe_breakdown: dict = {}
+        if self.serve_dtype == "bfloat16":
+            compute_dtype = jnp.bfloat16
+        else:
+            compute_dtype = (
+                jnp.bfloat16 if cfg.model.bfloat16 else jnp.float32
+            )
         self.model = End2EndModel(
             dim=cfg.model.dim, depth=cfg.model.depth, heads=cfg.model.heads,
             dim_head=cfg.model.dim_head, max_seq_len=cfg.model.max_seq_len,
@@ -229,9 +265,20 @@ class ServeEngine:
             remat=cfg.model.remat, msa_tie_row_attn=cfg.model.msa_tie_row_attn,
             context_parallel=cfg.model.context_parallel,
             grid_parallel=cfg.model.grid_parallel,
-            dtype=jnp.bfloat16 if cfg.model.bfloat16 else jnp.float32,
+            dtype=compute_dtype,
         )
         self.params = self._init_params(params, checkpoint_dir)
+        if self.serve_dtype == "bfloat16":
+            # cast float params ONCE at build: weight memory halves and the
+            # matmuls run bf16-in without per-dispatch casting. Checkpoints
+            # stay f32 on disk; the cast is a serving-time decision whose
+            # numerical safety observe/numerics drift bounds prove, not a
+            # training-state change.
+            self.params = jax.tree.map(
+                lambda x: x.astype(jnp.bfloat16)
+                if getattr(x, "dtype", None) == jnp.float32 else x,
+                self.params,
+            )
         self._mds_key = jax.random.key(cfg.train.seed)
         self._executables: dict = {}
         # params replicated onto the mesh once, reused by every sharded
@@ -347,7 +394,8 @@ class ServeEngine:
         The in-process dict makes reuse O(1); the persistent XLA compilation
         cache behind it (enable_compile_cache) makes even the first build of
         a known HLO a deserialization instead of a compile."""
-        key = (bucket, batch, self.mesh_desc)
+        key = (bucket, batch, self.mesh_desc, self.serve_dtype,
+               self.kernels_desc)
         hit = self._executables.get(key)
         if hit is not None:
             self.counters.bump("serve.cache_hits")
@@ -380,7 +428,9 @@ class ServeEngine:
                 warnings.filterwarnings(
                     "ignore", message="Some donated buffers were not usable"
                 )
-                with ctx:
+                from alphafold2_tpu.ops.kernels import use_kernel_policy
+
+                with ctx, use_kernel_policy(self.kernel_policy):
                     compiled = (
                         jax.jit(self._fwd, **jit_kwargs)
                         .lower(self.params, *abstract)
@@ -390,11 +440,29 @@ class ServeEngine:
         costs = executable_costs(compiled)  # flops/bytes via observe.flops
         self._exe_flops[key] = costs["flops"] or 0.0
         memory = executable_memory(compiled)  # per-device, via observe.flops
+        # analytical per-kernel attribution at this executable's static
+        # shapes (observe.flops): names the kernel responsible for an MFU
+        # delta — pair-axial vs tied-row MSA vs everything else
+        breakdown = attention_flops_attribution(
+            batch=batch, pair_len=3 * bucket, msa_depth=self.msa_depth,
+            msa_len=bucket, depth=self.cfg.model.depth,
+            heads=self.cfg.model.heads, dim_head=self.cfg.model.dim_head,
+            tie_rows=self.model.msa_tie_row_attn,
+            total_flops=costs["flops"],
+        )
+        self._exe_breakdown[key] = breakdown
         self.compile_records.append({
             "bucket": bucket, "batch": batch,
             "seconds": round(time.perf_counter() - t0, 4),
             **({"mesh": self.mesh_desc} if self.mesh_desc else {}),
+            # precision/kernel keys ride only when non-default so records
+            # (and the committed baselines) predating them stay comparable
+            **({"dtype": self.serve_dtype}
+               if self.serve_dtype != "float32" else {}),
+            **({"kernels": self.kernels_desc}
+               if self.kernels_desc != "auto" else {}),
             **({"flops": costs["flops"]} if costs["flops"] else {}),
+            **({"flops_breakdown": breakdown} if costs["flops"] else {}),
             **({"bytes_accessed": costs["bytes_accessed"]}
                if costs["bytes_accessed"] else {}),
             **memory,
@@ -605,9 +673,15 @@ class ServeEngine:
             dispatch_s = time.perf_counter() - t0
             batch_span.set(dispatch_s=round(dispatch_s, 4))
             self.histograms["dispatch_s"].observe(dispatch_s)
-            self.executed_flops += self._exe_flops.get(
-                (bucket, batch, self.mesh_desc), 0.0
-            )
+            exe_key = (bucket, batch, self.mesh_desc, self.serve_dtype,
+                       self.kernels_desc)
+            self.executed_flops += self._exe_flops.get(exe_key, 0.0)
+            for kernel, flops in self._exe_breakdown.get(
+                exe_key, {}
+            ).items():
+                self.executed_flops_breakdown[kernel] = (
+                    self.executed_flops_breakdown.get(kernel, 0.0) + flops
+                )
             self.memory.counter_to(self.tracer)  # HBM beside the spans
 
             with self.tracer.span("serve.unpad", bucket=bucket):

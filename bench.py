@@ -471,16 +471,21 @@ def main(overrides: dict | None = None, emit: bool = True,
 # serve baseline.
 _SERVE_INFRA_KNOBS = {"AF2TPU_SERVE_RECORD_BASELINE"}
 
-# the mesh-defining knobs select BETWEEN flagships (single-device vs the
-# sharded serve flagship), they do not size-override one: both the mesh
-# identity and the long-chain ladder ride in the metric label AND the
-# record's mesh key, and the regression gate (observe.regress) refuses any
-# cross-mesh comparison — so records stay self-keyed and safe to compare
-# against their own committed baseline (bench_serve_mesh_baseline.json)
+# the variant knobs select BETWEEN flagships (single-device vs sharded vs
+# bf16 vs tied-row), they do not size-override one: each variant's identity
+# rides in the metric label AND its own record key (mesh / dtype / tied
+# rows), and the regression gate (observe.regress) refuses any cross-key
+# comparison — so records stay self-keyed and safe to compare against their
+# own committed baseline (bench_serve_mesh_baseline.json /
+# bench_serve_bf16_baseline.json). AF2TPU_KERNELS likewise selects a
+# kernel-policy variant: it is not an AF2TPU_SERVE_ size override, and its
+# resolved identity rides in the record's "kernels" key.
 _SERVE_MESH_KNOBS = {
     "AF2TPU_SERVE_MESH",
     "AF2TPU_SERVE_LONG_BUCKETS",
     "AF2TPU_SERVE_LONG_REQUESTS",
+    "AF2TPU_SERVE_DTYPE",
+    "AF2TPU_SERVE_TIE_ROWS",
 }
 
 
@@ -547,6 +552,11 @@ def _serve_sizes() -> dict:
         "mesh": mesh_spec,
         "long_buckets": long_buckets,
         "long_requests": _env_int("AF2TPU_SERVE_LONG_REQUESTS", 1),
+        # precision/workload variants (not size overrides): bf16 serving
+        # routes to its own dtype-keyed baseline; tied rows turn on the
+        # MSA tied-row attention path (the tied-row kernel's shape)
+        "dtype": os.environ.get("AF2TPU_SERVE_DTYPE", "float32"),
+        "tie_rows": _env_int("AF2TPU_SERVE_TIE_ROWS", 0) != 0,
     }
 
 
@@ -565,6 +575,11 @@ def _serve_metric(s: dict) -> str:
             f"long={','.join(map(str, s['long_buckets'])) or '-'}"
             f"x{s['long_requests']}"
         )
+    if s.get("dtype", "float32") != "float32":
+        # the precision variant is likewise its own metric (and baseline)
+        label += f" dtype={s['dtype']}"
+    if s.get("tie_rows"):
+        label += " tied_rows"
     return label
 
 
@@ -601,6 +616,9 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
                 dim=s["dim"], depth=s["depth"], heads=s["heads"],
                 dim_head=s["dim_head"], max_seq_len=3 * top,
                 bfloat16=jax.devices()[0].platform != "cpu",
+                # the tied-rows variant exercises the tied-row MSA
+                # attention path (the tied-row kernel's shape)
+                msa_tie_row_attn=s["tie_rows"],
                 # a grid mesh needs the sharded axial primitive (the
                 # engine refuses the combination otherwise)
                 grid_parallel=bool(
@@ -612,6 +630,7 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
                 buckets=s["buckets"], max_batch=s["max_batch"],
                 mds_iters=s["mds_iters"],
                 long_buckets=s["long_buckets"] if mesh is not None else (),
+                dtype=s["dtype"],
             ),
         )
         engine = ServeEngine(cfg, tracer=tracer, mesh=mesh)
@@ -695,6 +714,13 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
         # XLA build durations keyed by executable shape
         "compile_records": engine.compile_records,
         "device": jax.devices()[0].device_kind,
+        # precision/kernel variant keys, present only when non-default so
+        # pre-existing baselines stay comparable; the regression gate
+        # refuses any cross-key comparison (observe.regress)
+        **({"dtype": engine.serve_dtype}
+           if engine.serve_dtype != "float32" else {}),
+        **({"kernels": engine.kernels_desc}
+           if engine.kernels_desc != "auto" else {}),
     }
     if mesh is not None:
         # mesh-keyed record: the identity string keys the executable
@@ -713,6 +739,13 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
     if executed_flops:
         # dispatched model flops over the timed stream (observe.flops)
         record["flops_total"] = executed_flops
+        if engine.executed_flops_breakdown:
+            # analytical per-kernel attribution (tied-row vs axial vs
+            # rest): an MFU delta names the attention family responsible
+            record["flops_by_kernel"] = {
+                k: round(v, 1)
+                for k, v in engine.executed_flops_breakdown.items()
+            }
         if mesh is not None:
             from alphafold2_tpu.observe.flops import mesh_mfu as _mesh_mfu
 
@@ -745,6 +778,8 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
     baseline_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         "bench_serve_mesh_baseline.json" if mesh is not None
+        else "bench_serve_bf16_baseline.json"
+        if engine.serve_dtype == "bfloat16"
         else "bench_serve_baseline.json",
     )
     vs, compared = 1.0, False
@@ -759,6 +794,9 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
             base.get("value")
             and base.get("metric") == record["metric"]
             and base.get("device") == record["device"]
+            # kernel policy is a variant key the metric label does not
+            # encode: a different selection is a different measurement
+            and base.get("kernels") == record.get("kernels")
         ):
             vs = record["value"] / base["value"]
             compared = True
@@ -1048,9 +1086,210 @@ def bench_serve_async(emit: bool = True, tracer: Tracer | None = None) -> dict:
     return record
 
 
+# ---------------------------------------------------------------- kernels ---
+
+
+def _kernels_sizes() -> dict:
+    """The kernels-microbench flagship: three tied-row and three axial
+    attention shapes, sized so the fused kernels' interpret-mode grids stay
+    small on CPU hosts (the committed CPU baseline is an interpret-mode
+    record; TPU sessions re-record compiled numbers under the same metric
+    machinery, keyed by device). AF2TPU_KERNELS_BENCH_* overrides mark the
+    record non-flagship (never baseline-compared)."""
+    return {
+        "iters": _env_int("AF2TPU_KERNELS_BENCH_ITERS", 5),
+        # (B, H, N, D) — the axial per-device pass after row-flattening
+        "axial": ((2, 4, 128, 64), (1, 4, 256, 64), (1, 2, 384, 64)),
+        # (B, R, N, H, D) — tied-row MSA attention
+        "tied": ((1, 4, 128, 4, 32), (1, 8, 128, 4, 64),
+                 (2, 16, 64, 2, 32)),
+    }
+
+
+def kernels_config_overridden() -> bool:
+    return any(k.startswith("AF2TPU_KERNELS_BENCH_") for k in os.environ)
+
+
+def _kernels_metric(s: dict) -> str:
+    fmt = lambda shapes: ",".join("x".join(map(str, sh)) for sh in shapes)
+    return (
+        f"kernels fused-vs-stock speedup axial={fmt(s['axial'])} "
+        f"tied={fmt(s['tied'])} iters={s['iters']}"
+    )
+
+
+def bench_kernels(emit: bool = True, tracer: Tracer | None = None) -> dict:
+    """Microbench: fused Pallas kernels vs stock XLA dense attention.
+
+    Times the in-repo fused kernels (ops/pallas/axial.py, tied_row.py)
+    against the jnp dense formulation at three shapes each, forward only
+    (the serving hot path). On CPU the fused side runs in Pallas interpret
+    mode — the committed CPU record is a regression canary for the
+    interpret path and the dispatch plumbing, not a speed claim; on TPU the
+    same driver times the compiled kernels and the speedup is the real
+    number. One JSON line, device/kernel-keyed, gated by
+    scripts/bench_compare.py against bench_kernels_baseline.json."""
+    import numpy as np
+
+    from alphafold2_tpu.ops.kernels import current_policy
+    from alphafold2_tpu.ops.pallas.axial import fused_attention
+    from alphafold2_tpu.ops.pallas.tied_row import tied_row_attention
+
+    owns_tracer = tracer is None
+    tracer = tracer if tracer is not None else _tracer()
+    s = _kernels_sizes()
+    iters = s["iters"]
+
+    def dense_axial(q, k, v, mask, scale):
+        dots = jnp.einsum("bhid,bhjd->bhij", q, k).astype(jnp.float32) * scale
+        dots = jnp.where(mask[:, None, None, :], dots, -1e9)
+        p = jax.nn.softmax(dots, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhij,bhjd->bhid", p, v)
+        return jnp.where(mask[:, None, :, None], out, 0)
+
+    def dense_tied(q, k, v, mask, shared, scale, tie_scale):
+        qz = jnp.where(mask[..., None, None], q, 0)
+        kz = jnp.where(mask[..., None, None], k, 0)
+        vz = jnp.where(mask[..., None, None], v, 0)
+        dots = (
+            jnp.einsum("brihd,brjhd->bhij", qz, kz).astype(jnp.float32)
+            * scale * tie_scale
+        )
+        dots = jnp.where(shared[:, None, None, :], dots, -1e9)
+        p = jax.nn.softmax(dots, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhij,brjhd->brihd", p, vz)
+
+    def timed(fn, args):
+        out = fn(*args)
+        jax.block_until_ready(out)  # compile + warm outside the timing
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+    rng = np.random.default_rng(0)
+    shapes: list = []
+    with _bench_stage(tracer, "kernels:backend_init"):
+        jax.devices()
+
+    with _bench_stage(tracer, "kernels:timed_run"):
+        for b, h, n, d in s["axial"]:
+            q, k, v = (
+                jnp.asarray(rng.standard_normal((b, h, n, d)), jnp.float32)
+                for _ in range(3)
+            )
+            mask = jnp.ones((b, n), bool).at[:, -max(1, n // 10):].set(False)
+            scale = d**-0.5
+            fused = jax.jit(lambda q, k, v, m, sc=scale: fused_attention(
+                q, k, v, q_mask=m, kv_mask=m, sm_scale=sc))
+            stock = jax.jit(lambda q, k, v, m, sc=scale: dense_axial(
+                q, k, v, m, sc))
+            fused_ms = timed(fused, (q, k, v, mask))
+            stock_ms = timed(stock, (q, k, v, mask))
+            shapes.append({
+                "name": f"axial_{b}x{h}x{n}x{d}",
+                "fused_ms": round(fused_ms, 3),
+                "stock_ms": round(stock_ms, 3),
+                "speedup": round(stock_ms / max(fused_ms, 1e-9), 4),
+            })
+        for b, r, n, h, d in s["tied"]:
+            q, k, v = (
+                jnp.asarray(
+                    rng.standard_normal((b, r, n, h, d)), jnp.float32
+                )
+                for _ in range(3)
+            )
+            mask = jnp.ones((b, r, n), bool).at[
+                :, :, -max(1, n // 10):
+            ].set(False)
+            shared = mask.any(1)  # (B, N) shared column mask
+            scale = d**-0.5
+            tie = float(r) ** -0.5
+            fused = jax.jit(
+                lambda q, k, v, m, sm, sc=scale, t=tie: tied_row_attention(
+                    jnp.where(m[..., None, None], q, 0),
+                    jnp.where(m[..., None, None], k, 0),
+                    jnp.where(m[..., None, None], v, 0),
+                    q_mask=sm, kv_mask=sm, sm_scale=sc, tie_scale=t,
+                )
+            )
+            stock = jax.jit(lambda q, k, v, m, sm, sc=scale, t=tie:
+                            dense_tied(q, k, v, m, sm, sc, t))
+            fused_ms = timed(fused, (q, k, v, mask, shared))
+            stock_ms = timed(stock, (q, k, v, mask, shared))
+            shapes.append({
+                "name": f"tied_{b}x{r}x{n}x{h}x{d}",
+                "fused_ms": round(fused_ms, 3),
+                "stock_ms": round(stock_ms, 3),
+                "speedup": round(stock_ms / max(fused_ms, 1e-9), 4),
+            })
+    _PHASE["name"] = "kernels:record"
+
+    speedups = [sh["speedup"] for sh in shapes]
+    geomean = float(np.exp(np.mean(np.log(np.maximum(speedups, 1e-9)))))
+    interpret = jax.default_backend() != "tpu"
+    record = {
+        "metric": _kernels_metric(s),
+        "value": round(geomean, 4),
+        "unit": "x-speedup",
+        "mode": "kernels",
+        "fused_ms_total": round(sum(sh["fused_ms"] for sh in shapes), 3),
+        "stock_ms_total": round(sum(sh["stock_ms"] for sh in shapes), 3),
+        "shapes": shapes,
+        # interpret-mode fused timings are a canary, not a speed claim —
+        # the flag keeps that explicit in the committed record
+        "interpret": interpret,
+        "kernels": current_policy().describe(),
+        "device": jax.devices()[0].device_kind,
+    }
+
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "bench_kernels_baseline.json",
+    )
+    vs, compared = 1.0, False
+    if os.path.exists(baseline_path) and not kernels_config_overridden():
+        with open(baseline_path) as f:
+            base = json.load(f)
+        if (
+            base.get("value")
+            and base.get("metric") == record["metric"]
+            and base.get("device") == record["device"]
+            and base.get("kernels") == record.get("kernels")
+        ):
+            vs = record["value"] / base["value"]
+            compared = True
+    record["vs_baseline"] = round(vs, 3)
+    record["vs_baseline_valid"] = compared
+
+    if (
+        os.environ.get("AF2TPU_KERNELS_RECORD_BASELINE") == "1"
+        and not kernels_config_overridden()
+    ):
+        with open(baseline_path, "w") as f:
+            json.dump(record, f, indent=2)
+        print(
+            f"recorded kernels baseline -> {baseline_path}", file=sys.stderr
+        )
+
+    logger = _metrics_logger()
+    if logger is not None:
+        logger.log(0, {
+            k: v for k, v in record.items()
+            if isinstance(v, (int, float, str, bool))
+        })
+    if owns_tracer:
+        tracer.close()
+    if emit:
+        _emit(record)
+    return record
+
+
 def bench_mode(argv=None) -> str:
     """The bench mode: 'train' (default flagship step bench), 'serve'
-    (closed-loop batched engine) or 'serve-async' (open-loop frontend).
+    (closed-loop batched engine), 'serve-async' (open-loop frontend) or
+    'kernels' (fused-vs-stock attention microbench).
     Spelled ``--mode serve`` / ``--mode=serve-async`` or AF2TPU_BENCH_MODE."""
     args = sys.argv[1:] if argv is None else argv
     for i, a in enumerate(args):
@@ -1256,13 +1495,17 @@ if __name__ == "__main__":
         ).start()
 
     _mode = bench_mode()
-    if _mode in ("serve", "serve-async"):
-        # the serve benches run wherever the engine runs (the CPU mesh
-        # included — that is the point: valid perf numbers without the
+    if _mode in ("serve", "serve-async", "kernels"):
+        # the serve/kernels benches run wherever the engine runs (the CPU
+        # mesh included — that is the point: valid perf numbers without the
         # tunnel); no preflight, no first-light, same watchdog + one-JSON-
         # line contract as the train bench
         try:
-            (bench_serve if _mode == "serve" else bench_serve_async)()
+            {
+                "serve": bench_serve,
+                "serve-async": bench_serve_async,
+                "kernels": bench_kernels,
+            }[_mode]()
             sys.exit(0)
         except Exception as e:
             _emit_failure(f"{type(e).__name__}: {e}")

@@ -67,10 +67,17 @@ def default_baseline_path(record: dict) -> str:
         # instead of) the single-device serve baseline, so CPU-mesh and
         # future TPU-pod numbers coexist behind the same gate
         name = "bench_serve_mesh_baseline.json"
+    elif record.get("mode") == "serve" and record.get("dtype") == "bfloat16":
+        # dtype-keyed baseline: the bf16 serving flagship competes against
+        # its own committed record — precision changes are explicit diffs
+        # against an explicit baseline, never a silent mutation of the f32
+        # serve numbers
+        name = "bench_serve_bf16_baseline.json"
     else:
         name = {
             "serve": "bench_serve_baseline.json",
             "serve-async": "bench_serve_async_baseline.json",
+            "kernels": "bench_kernels_baseline.json",
         }.get(record.get("mode"), "bench_baseline.json")
     return os.path.join(REPO, name)
 

@@ -197,6 +197,44 @@ def report_scheduler(latest: dict) -> None:
               f"p95 {latest['p95_ms']:.1f}ms  p99 {latest['p99_ms']:.1f}ms")
 
 
+def report_kernels(latest: dict) -> None:
+    """Kernels/precision section: printed when records carry the kernel-
+    policy or serving-dtype keys (ops/kernels.py KernelPolicy, serve.dtype)
+    or a --mode kernels microbench record rode the file. Shows the resolved
+    policy, the serving dtype and the per-kernel FLOPs attribution
+    (observe.flops: tied-row vs axial vs rest) so MFU conversations can
+    name the kernel responsible."""
+    compile_records = latest.get("compile_records") or []
+    by_kernel = latest.get("flops_by_kernel") or {}
+    has_keys = (
+        latest.get("kernels") or latest.get("dtype")
+        or latest.get("mode") == "kernels" or by_kernel
+        or any(c.get("kernels") or c.get("dtype") for c in compile_records)
+    )
+    if not has_keys:
+        return
+    print("-- kernels / precision --")
+    if latest.get("kernels"):
+        print(f"  kernel policy:  {latest['kernels']}")
+    if latest.get("dtype"):
+        print(f"  serve dtype:    {latest['dtype']}")
+    if latest.get("mode") == "kernels":
+        print(f"  fused-vs-stock: {latest.get('value')}x geomean "
+              f"(fused {latest.get('fused_ms_total')}ms, stock "
+              f"{latest.get('stock_ms_total')}ms"
+              + (", interpret mode" if latest.get("interpret") else "")
+              + ")")
+        for sh in latest.get("shapes") or []:
+            print(f"    {sh['name']:<22} fused {sh['fused_ms']:>8.3f}ms  "
+                  f"stock {sh['stock_ms']:>8.3f}ms  {sh['speedup']}x")
+    if by_kernel:
+        total = sum(by_kernel.values()) or 1.0
+        print("  executed FLOPs by kernel family:")
+        for name, flops in sorted(by_kernel.items(), key=lambda kv: -kv[1]):
+            print(f"    {name:<18} {flops / 1e9:>10.2f} GF  "
+                  f"({flops / total:.1%})")
+
+
 def report_mesh(latest: dict) -> None:
     """Mesh/sharding section: printed when records carry the mesh key
     (sharded serving, bench.py --mode serve with AF2TPU_SERVE_MESH).
@@ -258,6 +296,7 @@ def report_metrics(path: str) -> int:
     report_train(records)
     report_scheduler(latest)
     report_mesh(latest)
+    report_kernels(latest)
 
     compiles = latest.get("serve.compiles", latest.get("compiles"))
     hits = latest.get("serve.cache_hits", latest.get("cache_hits"))

@@ -145,6 +145,74 @@ def test_cli_default_baseline_routing(bench_compare):
     )
 
 
+# ------------------------------------------------- dtype / kernel keying
+
+KERNELS_BASE = {
+    "metric": "kernels fused-vs-stock speedup axial=... tied=... iters=5",
+    "device": "cpu", "mode": "kernels", "kernels": "auto",
+    "value": 0.5, "fused_ms_total": 15.0, "stock_ms_total": 8.0,
+    "interpret": True,
+}
+
+
+def test_kernels_threshold_selection_and_cliff():
+    """--mode kernels records select KERNELS_THRESHOLDS: the geomean
+    speedup is gated at 0.5x (an interpret-path blowup or a silent
+    fall-back-to-dense halves it), timings at wide cross-machine
+    tolerance."""
+    assert regress.thresholds_for(KERNELS_BASE) is regress.KERNELS_THRESHOLDS
+    ok = regress.compare({**KERNELS_BASE, "value": 0.3}, KERNELS_BASE)
+    assert ok["verdict"] == "pass"  # 0.6x of baseline: inside tolerance
+    cliff = regress.compare({**KERNELS_BASE, "value": 0.2}, KERNELS_BASE)
+    assert cliff["verdict"] == "regress" and "value" in cliff["regressions"]
+
+
+def test_dtype_and_kernel_records_never_cross_compare():
+    """A bf16 record vs an f32 one — or two different kernel policies — is
+    no-data, exactly like a mesh mismatch: precision/kernel changes are
+    explicit diffs, never silent ratio drift."""
+    bf16 = {**BASE, "dtype": "bfloat16"}
+    v = regress.compare(bf16, BASE)
+    assert v["verdict"] == "no-data" and "dtype mismatch" in v["reason"]
+    v = regress.compare(BASE, bf16)
+    assert v["verdict"] == "no-data" and "dtype mismatch" in v["reason"]
+    pol = {**BASE, "kernels": "tied_row=pallas"}
+    v = regress.compare(pol, BASE)
+    assert v["verdict"] == "no-data" and "kernels mismatch" in v["reason"]
+    # matching variant keys compare normally
+    v = regress.compare({**bf16, "value": 95.0}, bf16)
+    assert v["verdict"] == "pass"
+
+
+def test_cli_kernels_and_bf16_baseline_routing(bench_compare):
+    assert bench_compare.default_baseline_path(
+        {"mode": "kernels"}
+    ).endswith("bench_kernels_baseline.json")
+    assert bench_compare.default_baseline_path(
+        {"mode": "serve", "dtype": "bfloat16"}
+    ).endswith("bench_serve_bf16_baseline.json")
+    # mesh wins over dtype (the sharded flagship owns its baseline file)
+    assert bench_compare.default_baseline_path(
+        {"mode": "serve", "dtype": "bfloat16", "mesh": "dp1.spr2.spc4"}
+    ).endswith("bench_serve_mesh_baseline.json")
+
+
+def test_committed_kernels_and_bf16_baselines_are_valid():
+    """The committed kernel-microbench and bf16 serve baselines must be
+    usable measurements carrying their variant keys."""
+    with open(os.path.join(REPO, "bench_kernels_baseline.json")) as f:
+        kb = json.load(f)
+    assert regress.record_invalid_reason(kb) is None
+    assert kb["mode"] == "kernels" and "kernels" in kb
+    assert len(kb["shapes"]) == 6
+    with open(os.path.join(REPO, "bench_serve_bf16_baseline.json")) as f:
+        sb = json.load(f)
+    assert regress.record_invalid_reason(sb) is None
+    assert sb["dtype"] == "bfloat16" and "dtype=bfloat16" in sb["metric"]
+    assert sb["kernels"] == "tied_row=pallas"
+    assert sb["flops_by_kernel"]["tied_row"] > 0
+
+
 # ------------------------------------------------------------ mesh keying
 
 MESH_BASE = {
