@@ -29,6 +29,17 @@ first-class answer:
   bench, serve and the microbenchmarks.
 - :mod:`regress` — device-keyed perf regression gate over bench/serve
   records (``scripts/bench_compare.py`` is the CLI/CI entry point).
+- :mod:`tracectx` — request-scoped ``TraceContext`` (W3C-traceparent ids,
+  thread-local with explicit handoff) plus trace reconstruction and
+  completeness verification over emitted events.
+- :mod:`registry` — ``MetricsRegistry``: named counters/gauges/rolling
+  windows with periodic JSONL snapshots; :mod:`exposition` renders the
+  same snapshot as a Prometheus text endpoint (``AF2TPU_METRICS_PORT``).
+- :mod:`slo` — declarative ``SLOSpec`` objectives with multi-window
+  burn-rate alerting over the resolved-request stream.
+- :mod:`flightrec` — ``FlightRecorder``: bounded rings of recent
+  telemetry dumped as a scrubbed incident file on watchdog fire,
+  dispatch error, or SIGTERM.
 
 ``alphafold2_tpu.train.observe`` remains as a re-export shim for existing
 imports. ``scripts/obs_report.py`` summarizes the emitted artifacts.
@@ -38,26 +49,43 @@ lazily where a device is consulted), so host-side tools stay jax-free.
 """
 
 from alphafold2_tpu.observe import flops, numerics, regress
+from alphafold2_tpu.observe.flightrec import FlightRecorder, scrub_env
 from alphafold2_tpu.observe.histogram import Histogram
 from alphafold2_tpu.observe.memory import MemorySampler
 from alphafold2_tpu.observe.metrics import EventCounters, MetricsLogger
 from alphafold2_tpu.observe.numerics import tag
 from alphafold2_tpu.observe.profiler import Profiler
+from alphafold2_tpu.observe.registry import MetricsRegistry
+from alphafold2_tpu.observe.slo import SLOMonitor, SLOSpec, parse_slo_specs
+from alphafold2_tpu.observe.tracectx import (
+    TraceContext,
+    current_trace,
+    use_trace,
+)
 from alphafold2_tpu.observe.tracing import Span, Tracer
 from alphafold2_tpu.observe.watchdog import LivenessWatchdog, probe_backend
 
 __all__ = [
     "EventCounters",
+    "FlightRecorder",
     "Histogram",
     "LivenessWatchdog",
     "MemorySampler",
     "MetricsLogger",
+    "MetricsRegistry",
     "Profiler",
+    "SLOMonitor",
+    "SLOSpec",
     "Span",
+    "TraceContext",
     "Tracer",
+    "current_trace",
     "flops",
     "numerics",
+    "parse_slo_specs",
     "probe_backend",
     "regress",
+    "scrub_env",
     "tag",
+    "use_trace",
 ]

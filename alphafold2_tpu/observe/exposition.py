@@ -1,0 +1,153 @@
+"""Prometheus-text metrics exposition over stdlib HTTP.
+
+The ROADMAP's next open item — multi-replica fleet serving — needs a
+per-replica health/scrape surface the fleet scheduler can poll without a
+client library on either side. This module is that surface, pure stdlib:
+
+- :func:`render_prometheus` — a flat snapshot dict (the
+  :class:`~alphafold2_tpu.observe.registry.MetricsRegistry` /
+  ``EventCounters`` shape) as Prometheus text exposition format 0.0.4,
+  names sanitized and prefixed.
+- :class:`MetricsHTTPServer` — a ``ThreadingHTTPServer`` on a daemon
+  thread serving ``GET /metrics`` (the rendered snapshot, collected
+  per-request via a callback so the numbers are always current) and
+  ``GET /healthz`` (a small JSON liveness document).
+- :func:`serve_from_env` — the opt-in wiring: ``AF2TPU_METRICS_PORT``
+  set -> a server on that port (0 = ephemeral, for tests); unset -> None
+  and zero overhead, which is why it is safe to wire through bench
+  permanently.
+
+Binds 127.0.0.1 by default: the scrape surface is intentionally not
+exposed beyond the host unless a deployment overrides ``host``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+_NAME_OK = set("abcdefghijklmnopqrstuvwxyz"
+               "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:")
+
+
+def _sanitize(name: str) -> str:
+    out = "".join(c if c in _NAME_OK else "_" for c in name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def render_prometheus(snapshot: dict, prefix: str = "af2tpu") -> str:
+    """Flat ``{name: number}`` -> Prometheus text (format 0.0.4). Names
+    are prefixed and sanitized (``sched.cache_hits`` ->
+    ``af2tpu_sched_cache_hits``); non-numeric values are skipped (the
+    scrape surface is numbers; strings ride the JSONL channel)."""
+    lines = []
+    for name in sorted(snapshot):
+        value = snapshot[name]
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        metric = _sanitize(f"{prefix}_{name}" if prefix else name)
+        lines.append(f"# TYPE {metric} untyped")
+        lines.append(f"{metric} {float(value):g}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class MetricsHTTPServer:
+    """``/metrics`` + ``/healthz`` over ThreadingHTTPServer.
+
+    ``collect`` is called per ``/metrics`` request and must return the
+    flat snapshot dict; exceptions inside it yield a 500 instead of
+    killing the serving thread. ``port=0`` binds an ephemeral port (read
+    back via :attr:`port`)."""
+
+    def __init__(
+        self,
+        collect: Callable[[], dict],
+        port: int = 0,
+        host: str = "127.0.0.1",
+        prefix: str = "af2tpu",
+    ):
+        self._collect = collect
+        self._prefix = prefix
+        self._t0 = time.time()
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # stdout belongs to the bench record
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    try:
+                        body = render_prometheus(
+                            outer._collect(), prefix=outer._prefix
+                        ).encode()
+                    except Exception as e:
+                        self._send(
+                            500, f"collect failed: {e}".encode(),
+                            "text/plain",
+                        )
+                        return
+                    self._send(
+                        200, body,
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif path == "/healthz":
+                    doc = {
+                        "ok": True,
+                        "pid": os.getpid(),
+                        "uptime_s": round(time.time() - outer._t0, 1),
+                    }
+                    self._send(
+                        200, json.dumps(doc).encode(), "application/json"
+                    )
+                else:
+                    self._send(404, b"not found", "text/plain")
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "MetricsHTTPServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                name="af2-metrics-http", daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def serve_from_env(
+    collect: Callable[[], dict], var: str = "AF2TPU_METRICS_PORT"
+) -> Optional[MetricsHTTPServer]:
+    """Start an exposition server when ``$AF2TPU_METRICS_PORT`` is set
+    (0 = ephemeral); None (and no thread, no socket) when unset."""
+    raw = os.environ.get(var)
+    if raw is None or raw == "":
+        return None
+    return MetricsHTTPServer(collect, port=int(raw)).start()

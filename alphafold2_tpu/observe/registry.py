@@ -1,0 +1,265 @@
+"""MetricsRegistry: named counters/gauges/windowed series with snapshots.
+
+``EventCounters`` (metrics.py) is a flat monotonic counter map and
+``Histogram`` (histogram.py) a process-lifetime distribution — neither can
+answer "what was the error rate over the LAST minute", which is the shape
+SLO burn-rate math (observe/slo.py) and a fleet scheduler's scrape both
+need. This registry adds the time axis:
+
+- :class:`Counter` / :class:`Gauge` — plain named scalars.
+- :class:`WindowedCounter` — per-second buckets over a bounded horizon:
+  ``sum(window_s)`` / ``rate(window_s)`` answer rolling-rate questions in
+  O(window) with O(horizon) memory, however long the process lives.
+- :class:`WindowedValues` — a bounded deque of (t, value) samples with
+  windowed percentile snapshots (p50/p95/p99) — per-priority-class rolling
+  latency for the SLO monitor.
+- :class:`MetricsRegistry` — the named registry over all four, one lock,
+  ``snapshot()`` as a flat dict. ``start_snapshotter`` emits periodic
+  snapshots through the existing :class:`~alphafold2_tpu.observe.metrics.
+  MetricsLogger` JSONL channel (and any extra callback, e.g. the flight
+  recorder's ring buffer); ``observe/exposition.py`` renders the same
+  snapshot as Prometheus text.
+
+Injectable ``clock`` (default ``time.monotonic``) keeps every window
+deterministic under the fake-clock tests. Pure stdlib, jax-free.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+
+class Counter:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> float:
+        with self._lock:
+            self._value += n
+            return self._value
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class WindowedCounter:
+    """Per-second time buckets over a bounded horizon. ``add`` lands in
+    the current second's bucket; ``sum(window_s)`` totals the buckets
+    inside the window. Buckets past the horizon are pruned on touch, so
+    memory is O(horizon) regardless of process lifetime."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 horizon_s: float = 3600.0):
+        self._clock = clock
+        self._horizon = float(horizon_s)
+        self._buckets: dict = {}  # int(second) -> float
+        self._total = 0.0
+        self._lock = threading.Lock()
+
+    def _prune_locked(self, now: float) -> None:
+        floor = int(now - self._horizon)
+        if len(self._buckets) > self._horizon + 2:
+            for sec in [s for s in self._buckets if s < floor]:
+                del self._buckets[sec]
+
+    def add(self, n: float = 1.0) -> None:
+        now = self._clock()
+        with self._lock:
+            sec = int(now)
+            self._buckets[sec] = self._buckets.get(sec, 0.0) + n
+            self._total += n
+            self._prune_locked(now)
+
+    def sum(self, window_s: float) -> float:
+        now = self._clock()
+        floor = now - float(window_s)
+        with self._lock:
+            return sum(
+                v for sec, v in self._buckets.items() if sec + 1 > floor
+            )
+
+    def rate(self, window_s: float) -> float:
+        w = max(1e-9, float(window_s))
+        return self.sum(w) / w
+
+    @property
+    def total(self) -> float:
+        with self._lock:
+            return self._total
+
+
+class WindowedValues:
+    """Bounded (t, value) samples with windowed percentile snapshots.
+    ``maxlen`` bounds memory; within the window the newest ``maxlen``
+    samples are exact, which is the accuracy an SLO verdict needs."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 maxlen: int = 4096):
+        self._clock = clock
+        self._samples: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._samples.append((self._clock(), float(value)))
+
+    def values(self, window_s: Optional[float] = None) -> list:
+        with self._lock:
+            if window_s is None:
+                return [v for _, v in self._samples]
+            floor = self._clock() - float(window_s)
+            return [v for t, v in self._samples if t >= floor]
+
+    def snapshot(self, window_s: Optional[float] = None,
+                 digits: int = 4) -> dict:
+        vals = sorted(self.values(window_s))
+        if not vals:
+            return {"count": 0}
+        n = len(vals)
+
+        def pct(p: float) -> float:
+            return round(vals[min(n - 1, int(p * n))], digits)
+
+        return {
+            "count": n,
+            "p50": pct(0.50),
+            "p95": pct(0.95),
+            "p99": pct(0.99),
+            "max": round(vals[-1], digits),
+        }
+
+
+class MetricsRegistry:
+    """Named registry over Counter/Gauge/WindowedCounter/WindowedValues.
+
+    ``counter(name)`` et al. get-or-create (a name is one kind forever —
+    mixing kinds under one name raises). ``snapshot()`` flattens to plain
+    floats: counters/gauges by name, windowed counters as
+    ``name.rate_<window>s``, windowed values as ``name.p50/p95/p99``."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 snapshot_windows=(60.0,)):
+        self._clock = clock
+        self._snapshot_windows = tuple(snapshot_windows)
+        self._metrics: dict = {}  # name -> (kind, obj)
+        self._lock = threading.Lock()
+        self._snap_thread: Optional[threading.Thread] = None
+        self._snap_stop = threading.Event()
+
+    def _get(self, name: str, kind: str, factory):
+        with self._lock:
+            hit = self._metrics.get(name)
+            if hit is not None:
+                if hit[0] != kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as {hit[0]}, "
+                        f"not {kind}"
+                    )
+                return hit[1]
+            obj = factory()
+            self._metrics[name] = (kind, obj)
+            return obj
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, "counter", Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, "gauge", Gauge)
+
+    def windowed_counter(self, name: str,
+                         horizon_s: float = 3600.0) -> WindowedCounter:
+        return self._get(
+            name, "windowed_counter",
+            lambda: WindowedCounter(clock=self._clock, horizon_s=horizon_s),
+        )
+
+    def windowed_values(self, name: str,
+                        maxlen: int = 4096) -> WindowedValues:
+        return self._get(
+            name, "windowed_values",
+            lambda: WindowedValues(clock=self._clock, maxlen=maxlen),
+        )
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            items = list(self._metrics.items())
+        out: dict = {}
+        for name, (kind, obj) in items:
+            if kind in ("counter", "gauge"):
+                out[name] = obj.value
+            elif kind == "windowed_counter":
+                out[f"{name}.total"] = obj.total
+                for w in self._snapshot_windows:
+                    out[f"{name}.rate_{w:g}s"] = round(obj.rate(w), 6)
+            else:  # windowed_values
+                snap = obj.snapshot(
+                    self._snapshot_windows[0]
+                    if self._snapshot_windows else None
+                )
+                for k, v in snap.items():
+                    out[f"{name}.{k}"] = v
+        return out
+
+    # ---------------------------------------------------------- snapshotter
+
+    def start_snapshotter(
+        self,
+        logger,
+        period_s: float = 1.0,
+        also: Optional[Callable[[dict], None]] = None,
+    ) -> None:
+        """Periodic JSONL snapshots through a MetricsLogger (and ``also``,
+        e.g. the flight recorder). Daemon thread; one per registry."""
+        if self._snap_thread is not None:
+            return
+        self._snap_stop.clear()
+
+        def _run():
+            step = 0
+            while not self._snap_stop.wait(period_s):
+                step += 1
+                snap = self.snapshot()
+                try:
+                    if logger is not None:
+                        logger.log(step, {"registry": 1, **snap})
+                    if also is not None:
+                        also(snap)
+                except Exception:
+                    pass  # telemetry must never take the serving path down
+
+        self._snap_thread = threading.Thread(
+            target=_run, name="af2-metrics-snapshot", daemon=True
+        )
+        self._snap_thread.start()
+
+    def stop_snapshotter(self, timeout: float = 5.0) -> None:
+        if self._snap_thread is None:
+            return
+        self._snap_stop.set()
+        self._snap_thread.join(timeout)
+        self._snap_thread = None

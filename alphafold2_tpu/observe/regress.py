@@ -57,6 +57,20 @@ SERVE_ASYNC_THRESHOLDS = {
     "p95_ms": ("lower", 2.00),
     "p99_ms": ("lower", 2.00),
     "rejection_rate": ("lower", 1.00),
+    # per-priority-class tails: the class breakdown is what the SLO specs
+    # promise, so a high-class-only regression must not hide in the
+    # aggregate (a priority-inversion bug leaves p95_ms flat while
+    # p95_ms_high triples)
+    "p95_ms_high": ("lower", 2.00),
+    "p95_ms_normal": ("lower", 2.00),
+    "p95_ms_low": ("lower", 2.50),
+    "goodput_rps_high": ("higher", 0.60),
+    "goodput_rps_normal": ("higher", 0.60),
+    # absolute gates (baseline-independent): the telemetry plane's own
+    # contracts. Tracing/SLO/registry accounting may cost <5% goodput, and
+    # ≥99% of non-rejected requests must reconstruct a complete trace.
+    "telemetry_overhead_frac": ("absmax", 0.05),
+    "trace_complete_fraction": ("absmin", 0.99),
 }
 
 # mesh-sharded serve records (a "mesh" key beside mode=serve): throughput
@@ -156,6 +170,21 @@ def comparable_reason(current: dict, baseline: dict) -> Optional[str]:
 
 
 def _compare_one(name, cur, base, direction, tolerance) -> dict:
+    if direction in ("absmax", "absmin"):
+        # absolute bound on the CURRENT value: "tolerance" is the bound
+        # itself and the baseline is informational only — for metrics that
+        # are contracts (trace completeness, telemetry overhead), not
+        # measurements that drift with the machine
+        ok = cur <= tolerance if direction == "absmax" else cur >= tolerance
+        return {
+            "name": name,
+            "current": cur,
+            "baseline": base,
+            "ratio": None,
+            "direction": direction,
+            "tolerance": tolerance,
+            "ok": bool(ok),
+        }
     ratio = cur / base if base else None
     if ratio is None:
         ok = True  # zero/absent baseline value: nothing to gate on
@@ -192,6 +221,12 @@ def compare(
         "metric": current.get("metric") if isinstance(current, dict) else None,
         "device": current.get("device") if isinstance(current, dict) else None,
     }
+    if isinstance(current, dict) and isinstance(
+        current.get("slo_alerts"), (int, float)
+    ):
+        # informational, never gated: a legitimately-firing SLO alert on a
+        # fault-injected run must not flap CI, but the verdict should show it
+        out["slo_alerts"] = current["slo_alerts"]
 
     reason = record_invalid_reason(current)
     if reason is not None:
@@ -211,9 +246,18 @@ def compare(
     comparisons = []
     for name, (direction, tolerance) in thresholds.items():
         cur, base = current.get(name), baseline.get(name)
-        if not isinstance(cur, (int, float)) or not isinstance(
-            base, (int, float)
-        ):
+        if not isinstance(cur, (int, float)):
+            continue
+        if direction in ("absmax", "absmin"):
+            # absolute gates judge the current record alone; an older
+            # baseline without the metric must not disable the contract
+            comparisons.append(_compare_one(
+                name, float(cur),
+                float(base) if isinstance(base, (int, float)) else None,
+                direction, tolerance,
+            ))
+            continue
+        if not isinstance(base, (int, float)):
             continue
         comparisons.append(
             _compare_one(name, float(cur), float(base), direction, tolerance)
@@ -244,7 +288,7 @@ def parse_threshold_overrides(items, base: Optional[dict] = None) -> dict:
         direction, _, tol = spec.rpartition(":")
         if not direction:
             direction = out.get(name, ("higher", 0.0))[0]
-        if direction not in ("higher", "lower"):
+        if direction not in ("higher", "lower", "absmax", "absmin"):
             raise ValueError(f"bad direction {direction!r} in {item!r}")
         out[name] = (direction, float(tol))
     return out

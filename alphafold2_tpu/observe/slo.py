@@ -1,0 +1,259 @@
+"""Declarative SLOs with multi-window burn-rate alerting.
+
+A fixed threshold on a raw metric either pages on every blip (tight) or
+sleeps through a slow burn (loose). The standard fix is error-budget burn
+rate over TWO windows: the budget is the tolerated bad-event fraction
+(``1 - target``), the burn rate is ``observed bad fraction / budget``
+(1.0 = consuming exactly the budget), and an alert fires only when BOTH a
+fast window (catches it now) and a slow window (proves it is sustained,
+not one bad batch) exceed the threshold. Both windows' series come from
+the :mod:`~alphafold2_tpu.observe.registry` rolling structures.
+
+Objectives over :class:`~alphafold2_tpu.serve.engine.ServeResult` streams:
+
+- ``latency`` — bad = an ``ok`` result slower than ``threshold_ms``
+  (non-ok results are judged by the other objectives, not double-counted
+  as latency misses).
+- ``error_rate`` — bad = ``status == "error"`` among dispatched results.
+- ``deadline_miss`` — bad = ``status == "deadline_exceeded"`` among
+  admitted results.
+- ``availability`` — bad = any non-``ok`` outcome, rejections included
+  (the caller's view: did the service answer at all).
+
+Specs are per priority class (``high``/``normal``/``low`` from the
+request's scheduler priority, or ``None`` = all traffic) and parse from a
+compact text form (``AF2TPU_SLO_SPECS``) so a deployment can declare its
+objectives without code. Alerts are emitted as structured ``slo.alert``
+tracer events and surfaced in serve bench records and
+``observe/regress.py`` verdicts. Pure stdlib, fake-clock testable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+from alphafold2_tpu.observe.registry import MetricsRegistry
+
+OBJECTIVES = ("latency", "error_rate", "deadline_miss", "availability")
+
+
+def priority_class(priority: int) -> str:
+    """Scheduler priority -> SLO class name (>0 high, 0 normal, <0 low —
+    the same ordering admission control sheds by)."""
+    if priority > 0:
+        return "high"
+    if priority < 0:
+        return "low"
+    return "normal"
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """One objective. ``target`` is the good-event fraction promised
+    (0.99 = 1% error budget); ``burn_threshold`` is the burn rate that
+    alerts when sustained in both windows; ``min_events`` keeps a
+    near-empty window from alerting on one unlucky request."""
+
+    name: str
+    objective: str  # one of OBJECTIVES
+    target: float = 0.99
+    threshold_ms: Optional[float] = None  # latency objective only
+    priority_class: Optional[str] = None  # None = all classes
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    burn_threshold: float = 2.0
+    min_events: int = 10
+
+    def __post_init__(self):
+        if self.objective not in OBJECTIVES:
+            raise ValueError(
+                f"SLO {self.name!r}: objective {self.objective!r} not in "
+                f"{OBJECTIVES}"
+            )
+        if self.objective == "latency" and not self.threshold_ms:
+            raise ValueError(
+                f"SLO {self.name!r}: latency objective needs threshold_ms"
+            )
+        if not (0.0 < self.target < 1.0):
+            raise ValueError(
+                f"SLO {self.name!r}: target must be in (0, 1), got "
+                f"{self.target}"
+            )
+
+    @classmethod
+    def from_str(cls, text: str) -> "SLOSpec":
+        """Parse ``name,objective=latency,threshold_ms=500,target=0.95,
+        class=high`` (first comma field is the name; the rest k=v)."""
+        fields = [f.strip() for f in text.split(",") if f.strip()]
+        if not fields or "=" in fields[0]:
+            raise ValueError(
+                f"bad SLO spec {text!r}: first field must be the name"
+            )
+        kw: dict = {"name": fields[0]}
+        keymap = {
+            "objective": ("objective", str),
+            "target": ("target", float),
+            "threshold_ms": ("threshold_ms", float),
+            "class": ("priority_class", str),
+            "fast_window_s": ("fast_window_s", float),
+            "slow_window_s": ("slow_window_s", float),
+            "burn_threshold": ("burn_threshold", float),
+            "min_events": ("min_events", int),
+        }
+        for field in fields[1:]:
+            key, _, val = field.partition("=")
+            if key not in keymap:
+                raise ValueError(
+                    f"bad SLO spec {text!r}: unknown key {key!r}"
+                )
+            dest, cast = keymap[key]
+            kw[dest] = cast(val)
+        if "objective" not in kw:
+            raise ValueError(f"bad SLO spec {text!r}: objective missing")
+        return cls(**kw)
+
+
+def parse_slo_specs(text: str) -> list:
+    """Semicolon-separated :meth:`SLOSpec.from_str` forms -> spec list
+    (the ``AF2TPU_SLO_SPECS`` format)."""
+    return [
+        SLOSpec.from_str(part)
+        for part in (text or "").split(";")
+        if part.strip()
+    ]
+
+
+def default_serve_slos(deadline_s: float = 30.0) -> list:
+    """The serve bench's stock objectives: per-priority-class p-latency
+    (high promised a tighter bound than low), plus stream-wide error and
+    deadline-miss budgets. Latency thresholds scale with the configured
+    request deadline so the same specs fit smoke and flagship configs."""
+    lat_ms = max(1000.0, deadline_s * 1e3)
+    return [
+        SLOSpec(name="latency_high", objective="latency",
+                threshold_ms=0.5 * lat_ms, target=0.95,
+                priority_class="high"),
+        SLOSpec(name="latency_normal", objective="latency",
+                threshold_ms=0.8 * lat_ms, target=0.95,
+                priority_class="normal"),
+        SLOSpec(name="latency_low", objective="latency",
+                threshold_ms=1.0 * lat_ms, target=0.90,
+                priority_class="low"),
+        SLOSpec(name="error_rate", objective="error_rate", target=0.95),
+        SLOSpec(name="deadline_miss", objective="deadline_miss",
+                target=0.95),
+    ]
+
+
+class SLOMonitor:
+    """Feed :meth:`observe` every resolved ServeResult; read
+    :meth:`evaluate` for per-spec burn-rate verdicts and :meth:`alerts`
+    for the firing subset. Rolling series live in a
+    :class:`MetricsRegistry` (shared with the exposition endpoint when
+    the caller passes one in)."""
+
+    def __init__(
+        self,
+        specs,
+        registry: Optional[MetricsRegistry] = None,
+        clock: Callable[[], float] = time.monotonic,
+        tracer=None,
+    ):
+        self.specs = list(specs)
+        self._clock = clock
+        self.registry = (
+            registry if registry is not None
+            else MetricsRegistry(clock=clock)
+        )
+        self.tracer = tracer
+        self._alerted: set = set()  # spec names that have ever fired
+        for spec in self.specs:
+            self.registry.windowed_counter(f"slo.{spec.name}.good")
+            self.registry.windowed_counter(f"slo.{spec.name}.bad")
+
+    # ------------------------------------------------------------ ingestion
+
+    def _classify(self, spec: SLOSpec, status: str,
+                  latency_ms: float) -> Optional[bool]:
+        """True = good, False = bad, None = not this spec's event."""
+        if spec.objective == "latency":
+            if status != "ok":
+                return None
+            return latency_ms <= spec.threshold_ms
+        if spec.objective == "error_rate":
+            if status == "rejected":
+                return None  # never dispatched: not an error-rate event
+            return status != "error"
+        if spec.objective == "deadline_miss":
+            if status == "rejected":
+                return None
+            return status != "deadline_exceeded"
+        return status == "ok"  # availability
+
+    def observe(self, result, priority: int = 0) -> None:
+        """One resolved request. ``result`` is a ServeResult (or anything
+        with ``status`` and ``latency_s``)."""
+        status = getattr(result, "status", "ok")
+        latency_ms = float(getattr(result, "latency_s", 0.0) or 0.0) * 1e3
+        cls = priority_class(int(priority))
+        for spec in self.specs:
+            if spec.priority_class is not None and spec.priority_class != cls:
+                continue
+            good = self._classify(spec, status, latency_ms)
+            if good is None:
+                continue
+            kind = "good" if good else "bad"
+            self.registry.windowed_counter(f"slo.{spec.name}.{kind}").add()
+
+    # ----------------------------------------------------------- evaluation
+
+    def _burn(self, spec: SLOSpec, window_s: float):
+        good = self.registry.windowed_counter(
+            f"slo.{spec.name}.good"
+        ).sum(window_s)
+        bad = self.registry.windowed_counter(
+            f"slo.{spec.name}.bad"
+        ).sum(window_s)
+        total = good + bad
+        budget = 1.0 - spec.target
+        bad_frac = bad / total if total else 0.0
+        return bad_frac / budget if budget else 0.0, int(total)
+
+    def evaluate(self) -> list:
+        """One verdict dict per spec: fast/slow burn rates, event counts,
+        and whether the alert condition holds right now. A newly-firing
+        alert also emits a structured ``slo.alert`` tracer event."""
+        out = []
+        for spec in self.specs:
+            fast_burn, fast_n = self._burn(spec, spec.fast_window_s)
+            slow_burn, slow_n = self._burn(spec, spec.slow_window_s)
+            alert = (
+                fast_n >= spec.min_events
+                and fast_burn >= spec.burn_threshold
+                and slow_burn >= spec.burn_threshold
+            )
+            verdict = {
+                "spec": spec.name,
+                "objective": spec.objective,
+                "class": spec.priority_class or "all",
+                "target": spec.target,
+                "fast_burn": round(fast_burn, 3),
+                "slow_burn": round(slow_burn, 3),
+                "fast_events": fast_n,
+                "slow_events": slow_n,
+                "burn_threshold": spec.burn_threshold,
+                "alert": bool(alert),
+            }
+            if spec.threshold_ms is not None:
+                verdict["threshold_ms"] = spec.threshold_ms
+            if alert and spec.name not in self._alerted:
+                self._alerted.add(spec.name)
+                if self.tracer is not None:
+                    self.tracer.instant("slo.alert", **verdict)
+            out.append(verdict)
+        return out
+
+    def alerts(self) -> list:
+        return [v for v in self.evaluate() if v["alert"]]

@@ -20,7 +20,9 @@ import os
 import threading
 import time
 from contextlib import contextmanager
-from typing import Optional
+from typing import Optional, Tuple
+
+from alphafold2_tpu.observe.tracectx import current_trace, use_trace
 
 # one timeline origin per process: spans from every tracer share it, so a
 # serve-engine trace and a bench-stage trace interleave correctly
@@ -72,6 +74,7 @@ class Tracer:
         self._path = path
         self._lock = threading.Lock()
         self._events: list = []
+        self._sinks: list = []  # e.g. the flight recorder's ring buffer
         self._file = None
         if self.enabled and path:
             d = os.path.dirname(os.path.abspath(path))
@@ -87,9 +90,22 @@ class Tracer:
 
     # ------------------------------------------------------------- emission
 
+    def add_sink(self, sink) -> None:
+        """Register a callback receiving every emitted event dict (the
+        flight recorder's ring buffer attaches here). Called under the
+        tracer lock — sinks must be cheap and must not re-enter."""
+        with self._lock:
+            if sink not in self._sinks:
+                self._sinks.append(sink)
+
     def _emit(self, event: dict) -> None:
         with self._lock:
             self._events.append(event)
+            for sink in self._sinks:
+                try:
+                    sink(event)
+                except Exception:
+                    pass  # a broken sink must never lose the trace itself
             if self._file is not None:
                 self._file.write(json.dumps(event) + ",\n")
                 self._file.flush()
@@ -97,14 +113,29 @@ class Tracer:
     @contextmanager
     def span(self, name: str, **args):
         """Time a region; emits one complete event on exit (exceptions
-        included — a span that dies still appears, flagged ``error``)."""
+        included — a span that dies still appears, flagged ``error``).
+
+        When a :mod:`tracectx` context is active on this thread (and the
+        caller didn't attach ids explicitly), a child context is minted
+        for the region — nested spans chain parent ids automatically and
+        every event carries its owning ``trace_id``."""
         if not self.enabled:
             yield _NULL_SPAN
             return
         sp = Span(name, dict(args))
+        ctx = None
+        if "trace_id" not in sp.args:
+            cur = current_trace()
+            if cur is not None:
+                ctx = cur.child()
+                sp.args.update(ctx.event_args())
         t0 = _now_us()
         try:
-            yield sp
+            if ctx is not None:
+                with use_trace(ctx):
+                    yield sp
+            else:
+                yield sp
         except BaseException as e:
             sp.args["error"] = type(e).__name__
             raise
@@ -118,10 +149,32 @@ class Tracer:
                 **({"args": sp.args} if sp.args else {}),
             })
 
-    def instant(self, name: str, **args) -> None:
-        """A zero-duration marker event (ph "i")."""
+    def span_event(self, name: str, t0_s: float, t1_s: float, **args) -> None:
+        """Emit a complete span with EXPLICIT bounds (``time.perf_counter``
+        seconds) — for retroactive regions whose start predates the call,
+        e.g. the scheduler's per-request queue-residency span, known only
+        when the batch forms."""
         if not self.enabled:
             return
+        ts = (t0_s - _PROC_T0) * 1e6
+        dur = max(0.0, (t1_s - t0_s) * 1e6)
+        self._emit({
+            "name": name, "ph": "X", "ts": round(ts, 1),
+            "dur": round(dur, 1), "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            **({"args": dict(args)} if args else {}),
+        })
+
+    def instant(self, name: str, **args) -> None:
+        """A zero-duration marker event (ph "i"). Auto-attaches the
+        thread's active trace context like :meth:`span` (no child mint —
+        an instant is a point, not a region)."""
+        if not self.enabled:
+            return
+        if "trace_id" not in args:
+            cur = current_trace()
+            if cur is not None:
+                args = {**args, **cur.event_args()}
         self._emit({
             "name": name, "ph": "i", "ts": round(_now_us(), 1), "s": "p",
             "pid": os.getpid(), "tid": threading.get_ident(),
@@ -177,22 +230,51 @@ class Tracer:
 def load_trace_events(path: str) -> list:
     """Parse a trace file written by ``Tracer`` (or any Chrome trace-event
     JSON array). Tolerates the streaming form: leading ``[``, one event per
-    line with a trailing comma, no closing ``]``."""
+    line with a trailing comma, no closing ``]``. Raises on malformed
+    lines; use :func:`load_trace_events_lenient` to collect them instead."""
+    events, errors = load_trace_events_lenient(path)
+    if errors:
+        raise json.JSONDecodeError(
+            f"{len(errors)} malformed trace line(s) in {path} "
+            f"(first: {errors[0]})",
+            doc="", pos=0,
+        )
+    return events
+
+
+def load_trace_events_lenient(path: str) -> Tuple[list, list]:
+    """Like :func:`load_trace_events`, but a truncated/malformed line
+    (killed writer mid-flush, disk-full tail) becomes an entry in the
+    returned error list instead of an exception mid-parse — every parseable
+    event is still returned. Returns ``(events, errors)`` where each error
+    is a ``"line N: <detail>"`` string."""
     with open(path) as f:
         text = f.read().strip()
     if not text:
-        return []
+        return [], []
     try:  # a well-formed JSON array (or {"traceEvents": [...]})
         doc = json.loads(text)
         if isinstance(doc, dict):
-            return doc.get("traceEvents", [])
-        return doc
+            doc = doc.get("traceEvents", [])
+        if isinstance(doc, list):
+            return doc, []
+        return [], [f"line 1: top-level {type(doc).__name__}, not a list"]
     except json.JSONDecodeError:
         pass
-    events = []
-    for line in text.splitlines():
+    events, errors = [], []
+    for lineno, line in enumerate(text.splitlines(), start=1):
         line = line.strip().rstrip(",")
         if not line or line in ("[", "]"):
             continue
-        events.append(json.loads(line))
-    return events
+        try:
+            event = json.loads(line)
+        except json.JSONDecodeError as e:
+            errors.append(f"line {lineno}: {e.msg} ({line[:60]!r})")
+            continue
+        if isinstance(event, dict):
+            events.append(event)
+        else:
+            errors.append(
+                f"line {lineno}: event is {type(event).__name__}, not dict"
+            )
+    return events, errors

@@ -41,13 +41,16 @@ def result_key(seq: str, seed: int, mesh_desc: Optional[str] = None) -> tuple:
 class InFlightEntry:
     """One key's in-flight record: the leader token plus the follower
     contexts (opaque to the cache — the scheduler registers its pending
-    handles here) to resolve when the leader's dispatch completes."""
+    handles here) to resolve when the leader's dispatch completes.
+    ``leader_trace`` carries the leader's trace_id so a follower's
+    ``sched.dedup_join`` event can name the trace it attached to."""
 
-    __slots__ = ("key", "followers")
+    __slots__ = ("key", "followers", "leader_trace")
 
     def __init__(self, key):
         self.key = key
         self.followers: list = []
+        self.leader_trace: Optional[str] = None
 
 
 class ResultCache:

@@ -48,8 +48,10 @@ from alphafold2_tpu.observe import (
     EventCounters,
     Histogram,
     MemorySampler,
+    TraceContext,
     Tracer,
 )
+from alphafold2_tpu.observe import flightrec
 from alphafold2_tpu.observe.flops import (
     attention_flops_attribution,
     executable_costs,
@@ -77,13 +79,26 @@ class ServeRequest:
     bucket no longer accrue earlier buckets' dispatch time as "queue
     wait"). The async frontend (serve/scheduler.py) stamps it at submit;
     ``priority`` and ``deadline_s`` (relative seconds, 0/None = none) are
-    likewise scheduler inputs that ride with the request."""
+    likewise scheduler inputs that ride with the request.
+
+    ``trace`` is the request's :class:`~alphafold2_tpu.observe.tracectx.
+    TraceContext`, minted at construction when the caller doesn't hand one
+    in (an external frontend propagating a W3C traceparent would) — so
+    every request owns a trace_id from birth and every lifecycle event the
+    scheduler/engine emit is attributable to it."""
 
     seq: str
     seed: int = 0
     arrival_s: Optional[float] = None
     priority: int = 0
     deadline_s: Optional[float] = None
+    trace: Optional[TraceContext] = dataclasses.field(
+        default=None, compare=False, repr=False
+    )
+
+    def __post_init__(self):
+        if self.trace is None:
+            self.trace = TraceContext.new()
 
 
 @dataclasses.dataclass
@@ -110,6 +125,7 @@ class ServeResult:
     retry_after_s: Optional[float] = None  # backoff hint on "rejected"
     cache_hit: bool = False  # served from the result cache / in-flight dedup
     retried: bool = False  # produced by the scheduler's retry dispatch
+    trace_id: Optional[str] = None  # the owning request's trace identity
 
     @property
     def ok(self) -> bool:
@@ -576,6 +592,16 @@ class ServeEngine:
             self.counters.bump("serve.dispatch_errors")
             msg = f"{type(e).__name__}: {e}"
             dispatch_s = time.perf_counter() - t_start
+            rec = flightrec.active()
+            if rec is not None:  # preserve the telemetry leading up to it
+                rec.note(
+                    "dispatch_error", bucket=int(bucket), error=msg,
+                    n_real=len(chunk_reqs),
+                    trace_ids=[
+                        r.trace.trace_id for r in chunk_reqs if r.trace
+                    ],
+                )
+                rec.dump("dispatch_error")  # once per process (deduped)
             for slot, (req, idx) in enumerate(zip(chunk_reqs, chunk_idx)):
                 results[idx] = ServeResult(
                     seq=req.seq,
@@ -585,6 +611,7 @@ class ServeEngine:
                     latency_s=max(0.0, waits[slot]) + dispatch_s,
                     queue_wait_s=max(0.0, waits[slot]),
                     dispatch_s=dispatch_s,
+                    trace_id=req.trace.trace_id if req.trace else None,
                 )
 
     def _dispatch_inner(
@@ -596,8 +623,10 @@ class ServeEngine:
             # fault-injection hook: may delay (simulating a slow device) or
             # raise (converted to structured error results by the caller)
             self.faults.on_dispatch(dispatch_index, bucket)
+        member_traces = [r.trace.trace_id for r in chunk_reqs if r.trace]
         with self.tracer.span(
-            "serve.batch", bucket=bucket, batch=batch, n_real=n_real
+            "serve.batch", bucket=bucket, batch=batch, n_real=n_real,
+            **({"trace_ids": member_traces} if member_traces else {}),
         ) as batch_span:
             with self.tracer.span("serve.featurize", bucket=bucket):
                 items = []
@@ -706,6 +735,9 @@ class ServeEngine:
                         latency_s=latency,
                         queue_wait_s=wait,
                         dispatch_s=dispatch_s,
+                        trace_id=(
+                            req.trace.trace_id if req.trace else None
+                        ),
                     )
 
     def warmup(self) -> dict:

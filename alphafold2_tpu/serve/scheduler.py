@@ -31,6 +31,15 @@ Observability rides the PR-2 plumbing: ``sched.*`` counters (rejections,
 sheds, deadline misses, cache hits, dedups, retries) share the engine's
 ``EventCounters``; queue-depth / time-to-dispatch / dwell stream into
 ``observe.Histogram``; dispatches open ``sched.dispatch`` tracer spans.
+Every request carries a :class:`~alphafold2_tpu.observe.tracectx.
+TraceContext` from birth and the scheduler emits its full lifecycle as
+trace events — ``sched.submit`` (root), ``sched.queue`` (residency span),
+``sched.dispatch``/``sched.retry`` (batch spans listing member traces),
+``sched.cache_hit``/``sched.dedup_join`` (shared-result provenance, the
+join naming the leader's trace), ``sched.resolve`` (terminal, one per
+caller) — so one request's journey reconstructs from the trace JSONL
+alone (``observe.tracectx.reconstruct_traces``). ``add_observer`` hooks
+every resolution for the SLO monitor (``observe/slo.py``).
 ``bench.py --mode serve-async`` drives it open-loop with Poisson arrivals.
 
 Scheduling decisions use an injectable ``clock`` (default
@@ -48,6 +57,13 @@ import time
 from typing import Callable, Optional, Union
 
 from alphafold2_tpu.observe import Histogram, Tracer
+from alphafold2_tpu.observe.tracectx import (
+    CACHE_HIT_EVENT,
+    DEDUP_EVENT,
+    RESOLVE_EVENT,
+    SUBMIT_EVENT,
+    TraceContext,
+)
 from alphafold2_tpu.serve.bucketing import bucket_for
 from alphafold2_tpu.serve.cache import ResultCache, result_key
 from alphafold2_tpu.serve.engine import (
@@ -146,6 +162,7 @@ class AsyncServeFrontend:
             "dwell_s": Histogram(),
         }
         self._lock = threading.Condition()
+        self._observers: list = []  # fn(result, priority) at every resolve
         self._queues: dict = {}  # bucket -> list[_Pending], priority-sorted
         self._depth = 0
         self._seq_no = 0
@@ -205,6 +222,33 @@ class AsyncServeFrontend:
     def stats(self) -> dict:
         return self.counters.snapshot()
 
+    # ------------------------------------------------------------ observers
+
+    def add_observer(self, fn: Callable) -> None:
+        """Register ``fn(result, priority)``, called at EVERY resolution
+        (ok, error, rejected, deadline, cache hit, dedup follower) — the
+        SLO monitor's ingestion point, and the bench's per-class ledger."""
+        self._observers.append(fn)
+
+    def _notify(self, result: ServeResult, priority: int) -> None:
+        for fn in self._observers:
+            try:
+                fn(result, priority)
+            except Exception:
+                pass  # an observer must never take the serving path down
+
+    def _trace_resolve(
+        self, tctx: Optional[TraceContext], result: ServeResult
+    ) -> None:
+        """The terminal lifecycle event: one ``sched.resolve`` per caller
+        (followers get their own, on their own trace)."""
+        args = tctx.child().event_args() if tctx is not None else {}
+        self.tracer.instant(
+            RESOLVE_EVENT, status=result.status,
+            cache_hit=bool(result.cache_hit),
+            retried=bool(result.retried), **args,
+        )
+
     def histogram_snapshots(self, unit_scale: float = 1.0) -> dict:
         return {
             name: h.snapshot(
@@ -238,18 +282,32 @@ class AsyncServeFrontend:
         )
         handle = PendingResult(req)
         self.counters.bump("sched.submitted")
+        tctx = req.trace
+        # the trace root: every request — admitted, shed, or unservable —
+        # gets exactly one, carrying the root span (no parent_id)
+        self.tracer.instant(
+            SUBMIT_EVENT, priority=int(priority),
+            **(tctx.event_args() if tctx is not None else {}),
+        )
 
         try:
             if not req.seq:
                 raise ValueError("empty sequence")
             bucket = bucket_for(len(req.seq), self.engine.buckets)
         except ValueError as e:
-            handle._resolve(ServeResult(
+            res = ServeResult(
                 seq=req.seq, bucket=0, status="rejected",
                 error=f"unservable request: {e}",
-            ))
+                trace_id=tctx.trace_id if tctx is not None else None,
+            )
+            handle._resolve(res)
             self.counters.bump("sched.rejected")
-            self.tracer.instant("sched.reject", reason="unservable")
+            self.tracer.instant(
+                "sched.reject", reason="unservable",
+                **(tctx.child().event_args() if tctx is not None else {}),
+            )
+            self._trace_resolve(tctx, res)
+            self._notify(res, priority)
             return handle
 
         # mesh identity rides in the key (serve/cache.py): results from a
@@ -257,16 +315,33 @@ class AsyncServeFrontend:
         # not byte-identical, so they must never dedup onto each other
         key = result_key(req.seq, req.seed, self.engine.mesh_desc)
         status, payload = self.cache.lookup_or_claim(
-            key, follower_ctx=(handle, now)
+            key, follower_ctx=(handle, now, tctx, priority)
         )
         if status == "hit":
             self.counters.bump("sched.cache_hits")
-            handle._resolve(self._shared_result(payload, now))
+            res = self._shared_result(payload, now, trace=tctx)
+            handle._resolve(res)
+            self.tracer.instant(
+                CACHE_HIT_EVENT, bucket=bucket,
+                **(tctx.child().event_args() if tctx is not None else {}),
+            )
+            self._trace_resolve(tctx, res)
+            self._notify(res, priority)
             return handle
         if status == "follower":
-            # rides the in-flight leader's dispatch; no queue slot consumed
+            # rides the in-flight leader's dispatch; no queue slot consumed.
+            # The join event names the leader's trace so the two lifecycles
+            # cross-reference from either side of the dedup.
             self.counters.bump("sched.inflight_dedup")
+            self.tracer.instant(
+                DEDUP_EVENT, bucket=bucket,
+                **({"leader_trace": payload.leader_trace}
+                   if payload.leader_trace else {}),
+                **(tctx.child().event_args() if tctx is not None else {}),
+            )
             return handle
+        if tctx is not None:
+            payload.leader_trace = tctx.trace_id  # the InFlightEntry
 
         # leader: admission control under the scheduler lock
         with self._lock:
@@ -300,7 +375,10 @@ class AsyncServeFrontend:
         self.counters.bump("sched.rejected")
         if counter == "sched.shed":
             self.counters.bump("sched.shed")
-        self.tracer.instant("sched.reject", reason=reason, bucket=bucket)
+        self.tracer.instant(
+            "sched.reject", reason=reason, bucket=bucket,
+            **(tctx.child().event_args() if tctx is not None else {}),
+        )
         self._resolve_leader(
             _Pending(
                 req=req, handle=handle, key=key, bucket=bucket,
@@ -326,14 +404,19 @@ class AsyncServeFrontend:
         batches_ahead = self._depth // self.engine.max_batch + 1
         return round(batches_ahead * per_batch, 4)
 
-    def _shared_result(self, result: ServeResult, submit_ts: float) -> (
-        ServeResult
-    ):
+    def _shared_result(
+        self,
+        result: ServeResult,
+        submit_ts: float,
+        trace: Optional[TraceContext] = None,
+    ) -> ServeResult:
         """A cached/deduped caller's view of a shared result: identical
-        arrays (byte-for-byte — same objects), per-caller latency."""
+        arrays (byte-for-byte — same objects), per-caller latency, and the
+        CALLER's trace identity (the shared result carries the leader's)."""
         wait = max(0.0, self._clock() - submit_ts)
         return dataclasses.replace(
             result, cache_hit=True, latency_s=wait, queue_wait_s=wait,
+            **({"trace_id": trace.trace_id} if trace is not None else {}),
         )
 
     # ------------------------------------------------------------- dispatch
@@ -373,7 +456,11 @@ class AsyncServeFrontend:
                     plans.append((bucket, take))
         for p in expired:
             self.counters.bump("sched.deadline_miss")
-            self.tracer.instant("sched.deadline_miss", bucket=p.bucket)
+            self.tracer.instant(
+                "sched.deadline_miss", bucket=p.bucket,
+                **(p.req.trace.child().event_args()
+                   if p.req.trace is not None else {}),
+            )
             self._resolve_leader(
                 p,
                 ServeResult(
@@ -400,13 +487,23 @@ class AsyncServeFrontend:
             self.histograms["time_to_dispatch_s"].observe(
                 max(0.0, formed_at - p.enqueued)
             )
+            if p.req.trace is not None:
+                # retroactive queue-residency span: the region is only
+                # known once the batch forms, so it is emitted with
+                # explicit bounds rather than timed live
+                self.tracer.span_event(
+                    "sched.queue", p.enqueued, formed_at, bucket=bucket,
+                    **p.req.trace.child().event_args(),
+                )
         reqs = [p.req for p in pendings]
+        member_traces = [r.trace.trace_id for r in reqs if r.trace]
         t0 = self._clock()
         mesh_attr = (
             {"mesh": self.engine.mesh_desc} if self.engine.mesh_desc else {}
         )
         with self.tracer.span(
-            "sched.dispatch", bucket=bucket, n=len(reqs), **mesh_attr
+            "sched.dispatch", bucket=bucket, n=len(reqs), **mesh_attr,
+            **({"trace_ids": member_traces} if member_traces else {}),
         ):
             results = self.engine.dispatch_batch(bucket, reqs)
         dt = max(0.0, self._clock() - t0)
@@ -422,9 +519,13 @@ class AsyncServeFrontend:
             # whatever poisoned the first), else the same rung again
             retry_at = self.engine.retry_bucket(bucket) or bucket
             self.counters.bump("sched.retries", len(failed))
+            retry_traces = [
+                reqs[i].trace.trace_id for i in failed if reqs[i].trace
+            ]
             with self.tracer.span(
                 "sched.retry", bucket=retry_at, failed_bucket=bucket,
                 n=len(failed),
+                **({"trace_ids": retry_traces} if retry_traces else {}),
             ):
                 retried = self.engine.dispatch_batch(
                     retry_at, [reqs[i] for i in failed]
@@ -442,12 +543,23 @@ class AsyncServeFrontend:
     ) -> None:
         """Resolve a leader's handle and fan the result out to every
         follower deduped onto its key (sharing failures too — one dispatch,
-        one outcome). Only ok results enter the LRU."""
+        one outcome). Only ok results enter the LRU. Every resolution —
+        leader and followers — emits its own terminal ``sched.resolve``
+        on its own trace and reaches every registered observer."""
+        tctx = pending.req.trace
+        if tctx is not None and result.trace_id != tctx.trace_id:
+            result = dataclasses.replace(result, trace_id=tctx.trace_id)
         pending.handle._resolve(result)
-        for handle, submit_ts in self.cache.fulfill(
-            pending.key, result, cache=cache_ok
-        ):
-            handle._resolve(self._shared_result(result, submit_ts))
+        self._trace_resolve(tctx, result)
+        self._notify(result, pending.priority)
+        for ctx in self.cache.fulfill(pending.key, result, cache=cache_ok):
+            handle, submit_ts = ctx[0], ctx[1]
+            f_trace = ctx[2] if len(ctx) > 2 else None
+            f_priority = ctx[3] if len(ctx) > 3 else 0
+            shared = self._shared_result(result, submit_ts, trace=f_trace)
+            handle._resolve(shared)
+            self._trace_resolve(f_trace, shared)
+            self._notify(shared, f_priority)
 
     # --------------------------------------------------------------- thread
 

@@ -132,6 +132,7 @@ from alphafold2_tpu.observe import (
     MetricsLogger,
     Tracer,
 )
+from alphafold2_tpu.observe import exposition, flightrec
 
 # the tree's single cost_analysis()/MFU implementation (observe.flops):
 # bench, the serve engine, the train loop and bisect_perf all share it
@@ -145,8 +146,14 @@ from alphafold2_tpu.observe.flops import (
 
 def _tracer() -> Tracer:
     """Span tracer for this bench invocation: Chrome trace-event JSONL at
-    $AF2TPU_TRACE_EVENTS (Perfetto-loadable), disabled when unset."""
-    return Tracer.from_env()
+    $AF2TPU_TRACE_EVENTS (Perfetto-loadable), disabled when unset. The
+    active flight recorder (if any) rides along as a sink, so its ring
+    buffer sees every span the file does."""
+    t = Tracer.from_env()
+    rec = flightrec.active()
+    if rec is not None and t.enabled:
+        rec.attach(t)
+    return t
 
 
 def _metrics_logger():
@@ -863,18 +870,92 @@ def _serve_async_sizes() -> dict:
         ),
         "cache_size": _env_int("AF2TPU_SERVE_ASYNC_CACHE", 64),
         "seed": _env_int("AF2TPU_SERVE_ASYNC_SEED", 0),
+        # workload definition like dup_fraction: the priority-class mix
+        # (high/normal/low shares) the per-class latency breakdowns and
+        # per-class SLO specs are evaluated over
+        "class_mix": (0.2, 0.6, 0.2),
     }
 
 
 def _serve_async_metric(s: dict) -> str:
+    mix = "/".join(f"{v:g}" for v in s["class_mix"])
     return (
         f"serve-async residues/sec buckets={','.join(map(str, s['buckets']))} "
         f"max_batch={s['max_batch']} requests={s['requests']} "
-        f"rate={s['rate']:g}/s dup={s['dup_fraction']:g} dim={s['dim']} "
+        f"rate={s['rate']:g}/s dup={s['dup_fraction']:g} classes={mix} "
+        f"dim={s['dim']} "
         f"depth={s['depth']} msa_depth={s['msa_depth']} "
         f"mds_iters={s['mds_iters']} dwell_ms={s['dwell_ms']:g} "
         f"queue={s['queue_depth']} deadline_s={s['deadline_s']:g}"
     )
+
+
+def _telemetry_overhead_probe(engine, s: dict, arms: int = 2,
+                              n_requests: int = 12) -> dict:
+    """The telemetry plane's cost, measured: identical closed-loop bursts
+    through fresh frontends against the ALREADY-WARM engine, alternating
+    telemetry off (disabled tracer, no observers) and on (memory tracer +
+    SLO monitor + registry feed), best-of-``arms`` per arm so a one-off
+    scheduler hiccup doesn't fake an overhead. The burst stays under the
+    queue depth at high priority, so admission control never varies
+    between arms."""
+    import numpy as np
+
+    from alphafold2_tpu.observe.registry import MetricsRegistry
+    from alphafold2_tpu.observe.slo import SLOMonitor, default_serve_slos
+    from alphafold2_tpu.serve import AsyncServeFrontend, ServeRequest
+
+    rng = np.random.default_rng(s["seed"] + 1)
+    lo = max(4, s["buckets"][0] // 2)
+    alpha = "ACDEFGHIKLMNPQRSTVWY"
+    n = max(1, min(n_requests, s["queue_depth"] - 2))
+    seqs = [
+        "".join(rng.choice(
+            list(alpha), size=int(rng.integers(lo, s["buckets"][-1] + 1))
+        ))
+        for _ in range(n)
+    ]
+
+    def run(telemetry: bool) -> float:
+        tr = Tracer(enabled=telemetry)  # memory-only when on
+        old_engine_tracer = engine.tracer
+        engine.tracer = tr  # the engine's serve.* spans are part of the cost
+        try:
+            fe = AsyncServeFrontend(engine, tracer=tr)
+            if telemetry:
+                mon = SLOMonitor(
+                    default_serve_slos(s["deadline_s"]),
+                    registry=MetricsRegistry(), tracer=tr,
+                )
+                fe.add_observer(mon.observe)
+            t0 = time.perf_counter()
+            handles = [
+                fe.submit(ServeRequest(seq=q, seed=j, priority=1))
+                for j, q in enumerate(seqs)
+            ]
+            n_ok = sum(
+                1 for h in handles if h.result(timeout=600).status == "ok"
+            )
+            wall = time.perf_counter() - t0
+            fe.close()
+            return n_ok / wall if wall > 0 else 0.0
+        finally:
+            engine.tracer = old_engine_tracer
+
+    best = {"off": 0.0, "on": 0.0}
+    for _ in range(max(1, arms)):
+        for name, tel in (("off", False), ("on", True)):
+            best[name] = max(best[name], run(tel))
+    frac = (
+        max(0.0, 1.0 - best["on"] / best["off"]) if best["off"] else 0.0
+    )
+    return {
+        "goodput_rps_off": round(best["off"], 3),
+        "goodput_rps_on": round(best["on"], 3),
+        "requests_per_arm": n,
+        "arms": arms,
+        "overhead_frac": round(frac, 4),
+    }
 
 
 def bench_serve_async(emit: bool = True, tracer: Tracer | None = None) -> dict:
@@ -891,19 +972,40 @@ def bench_serve_async(emit: bool = True, tracer: Tracer | None = None) -> dict:
     failure counts (deadline misses, cache hits, in-flight dedups,
     retries, dispatch errors). ``AF2TPU_SERVE_ASYNC_FAULT`` (e.g.
     ``"dispatch=2,times=1"``) injects a FaultPlan for degradation drills —
-    like every AF2TPU_SERVE_* knob it marks the record non-flagship."""
+    like every AF2TPU_SERVE_* knob it marks the record non-flagship.
+
+    The telemetry plane is ALWAYS on for the headline run (a memory-only
+    tracer when $AF2TPU_TRACE_EVENTS is unset): the record carries the
+    trace-reconstruction completeness fraction over non-rejected requests,
+    per-priority-class latency/goodput breakdowns, SLO burn-rate verdicts
+    (``AF2TPU_SLO_SPECS`` overrides the default specs), and a measured
+    telemetry-on-vs-off overhead fraction — the last two gated by
+    ``observe/regress.py``'s absolute thresholds."""
     import numpy as np
 
     from alphafold2_tpu.config import (
         Config, DataConfig, ModelConfig, ServeConfig,
     )
     from alphafold2_tpu.observe import Histogram
+    from alphafold2_tpu.observe.registry import MetricsRegistry
+    from alphafold2_tpu.observe.slo import (
+        SLOMonitor, default_serve_slos, parse_slo_specs, priority_class,
+    )
+    from alphafold2_tpu.observe.tracectx import trace_completeness
     from alphafold2_tpu.serve import (
         AsyncServeFrontend, FaultPlan, ServeEngine, ServeRequest,
     )
 
     owns_tracer = tracer is None
     tracer = tracer if tracer is not None else _tracer()
+    if not tracer.enabled:
+        # the telemetry contract (trace completeness, SLO ingestion) needs
+        # live events even when no trace file was requested
+        tracer = Tracer(enabled=True)
+        owns_tracer = True
+    rec_fr = flightrec.maybe_install_from_env()
+    if rec_fr is not None:
+        rec_fr.attach(tracer)
     s = _serve_async_sizes()
     with _bench_stage(tracer, "serve_async:backend_init"):
         cfg = Config(
@@ -927,18 +1029,28 @@ def bench_serve_async(emit: bool = True, tracer: Tracer | None = None) -> dict:
         engine = ServeEngine(cfg, tracer=tracer, faults=faults)
 
     # deterministic open-loop workload: Poisson arrivals, mixed lengths,
-    # ~dup_fraction repeats of earlier (seq, seed) pairs (cache/dedup food)
+    # ~dup_fraction repeats of earlier (seq, seed) pairs (cache/dedup
+    # food), priorities drawn from class_mix. A repeat is a FRESH request
+    # object with the same (seq, seed): its own arrival, priority, and
+    # trace identity — two users submitting the same sequence are two
+    # lifecycles that happen to share one dispatch
     rng = np.random.default_rng(s["seed"])
     lo = max(4, s["buckets"][0] // 2)
     alpha = "ACDEFGHIKLMNPQRSTVWY"
+    pri_levels = np.array([1, 0, -1])
     reqs: list = []
     for i in range(s["requests"]):
+        priority = int(rng.choice(pri_levels, p=np.array(s["class_mix"])))
         if reqs and rng.random() < s["dup_fraction"]:
-            reqs.append(reqs[rng.integers(0, len(reqs))])
+            src = reqs[int(rng.integers(0, len(reqs)))]
+            reqs.append(ServeRequest(
+                seq=src.seq, seed=src.seed, priority=priority
+            ))
         else:
             n = int(rng.integers(lo, s["buckets"][-1] + 1))
             reqs.append(ServeRequest(
-                seq="".join(rng.choice(list(alpha), size=n)), seed=i
+                seq="".join(rng.choice(list(alpha), size=n)), seed=i,
+                priority=priority,
             ))
     gaps = rng.exponential(1.0 / s["rate"], size=s["requests"])
 
@@ -955,7 +1067,36 @@ def bench_serve_async(emit: bool = True, tracer: Tracer | None = None) -> dict:
         with _bench_stage(tracer, "serve_async:clock_probe"):
             _CLOCK["probe"] = _clock_probe()
 
+    # telemetry plane around the timed run: SLO monitor + rolling-window
+    # registry fed from every resolution, periodic snapshots to the JSONL
+    # channel (and the flight recorder), optional Prometheus exposition
+    logger = _metrics_logger()
+    registry = MetricsRegistry()
+    slo_specs = parse_slo_specs(
+        os.environ.get("AF2TPU_SLO_SPECS", "")
+    ) or default_serve_slos(s["deadline_s"])
+    slo_monitor = SLOMonitor(slo_specs, registry=registry, tracer=tracer)
+
+    def _feed_registry(result, priority):
+        registry.windowed_counter(f"serve.resolved.{result.status}").add()
+        if result.status == "ok":
+            registry.windowed_values(
+                f"serve.latency_ms.{priority_class(priority)}"
+            ).observe(result.latency_s * 1e3)
+
     frontend = AsyncServeFrontend(engine, tracer=tracer)
+    frontend.add_observer(slo_monitor.observe)
+    frontend.add_observer(_feed_registry)
+    metrics_server = exposition.serve_from_env(
+        lambda: {**engine.counters.snapshot(), **registry.snapshot()}
+    )
+    registry.start_snapshotter(
+        logger, period_s=0.5,
+        also=(
+            (lambda snap: rec_fr.snapshot("registry", snap))
+            if rec_fr is not None else None
+        ),
+    )
     with _bench_stage(tracer, "serve_async:timed_run"):
         t0 = time.perf_counter()
         handles = []
@@ -969,6 +1110,8 @@ def bench_serve_async(emit: bool = True, tracer: Tracer | None = None) -> dict:
         results = [h.result(timeout=600) for h in handles]
         wall = time.perf_counter() - t0
     frontend.close()
+    registry.stop_snapshotter()
+    slo_verdicts = slo_monitor.evaluate()
     _PHASE["name"] = "serve_async:record"
 
     ok = [r for r in results if r.status == "ok"]
@@ -982,6 +1125,48 @@ def bench_serve_async(emit: bool = True, tracer: Tracer | None = None) -> dict:
         lat.observe(r.latency_s)
     lat_ms = lat.snapshot(unit_scale=1e3, digits=4) if ok else {"count": 0}
     stats = frontend.stats()
+
+    # per-priority-class breakdown: what the per-class SLO specs promise,
+    # and what the per-class regression thresholds gate
+    class_acc: dict = {}
+    for req, r in zip(reqs, results):
+        b = class_acc.setdefault(
+            priority_class(req.priority),
+            {"requests": 0, "completed": 0, "rejected": 0,
+             "hist": Histogram()},
+        )
+        b["requests"] += 1
+        if r.status == "ok":
+            b["completed"] += 1
+            b["hist"].observe(r.latency_s)
+        elif r.status == "rejected":
+            b["rejected"] += 1
+    by_class = {}
+    for cls, b in sorted(class_acc.items()):
+        snap = (
+            b["hist"].snapshot(unit_scale=1e3, digits=4)
+            if b["completed"] else {"count": 0}
+        )
+        by_class[cls] = {
+            "requests": b["requests"],
+            "completed": b["completed"],
+            "rejected": b["rejected"],
+            "goodput_rps": round(b["completed"] / wall, 3),
+            "p50_ms": round(snap.get("p50", 0.0), 1),
+            "p95_ms": round(snap.get("p95", 0.0), 1),
+            "p99_ms": round(snap.get("p99", 0.0), 1),
+        }
+
+    # trace reconstruction: every non-rejected request's lifecycle must
+    # rebuild from the emitted events as an unbroken span chain
+    completeness = trace_completeness(
+        tracer.events(),
+        [r.trace_id for r in results
+         if r.status != "rejected" and r.trace_id],
+    )
+
+    with _bench_stage(tracer, "serve_async:overhead_probe"):
+        overhead = _telemetry_overhead_probe(engine, s)
     hists = {
         (n[:-2] + "_ms" if n.endswith("_s") else n): snap
         for n, snap in {
@@ -1016,7 +1201,27 @@ def bench_serve_async(emit: bool = True, tracer: Tracer | None = None) -> dict:
         "histograms": hists,
         "compile_records": engine.compile_records,
         "device": jax.devices()[0].device_kind,
+        "by_class": by_class,
+        "trace": completeness,
+        "trace_complete_fraction": completeness["fraction"],
+        "slo": slo_verdicts,
+        "slo_alerts": sum(1 for v in slo_verdicts if v["alert"]),
+        "telemetry_overhead": overhead,
+        "telemetry_overhead_frac": overhead["overhead_frac"],
     }
+    # flat per-class keys beside the nested breakdown: the regression
+    # gate's threshold table addresses record keys by name
+    for cls, b in by_class.items():
+        record[f"p95_ms_{cls}"] = b["p95_ms"]
+        record[f"goodput_rps_{cls}"] = b["goodput_rps"]
+    if metrics_server is not None:
+        record["metrics_port"] = metrics_server.port
+    if rec_fr is not None and (
+        os.environ.get("AF2TPU_FLIGHTREC_FORCE_DUMP") == "1"
+    ):
+        dump_path = rec_fr.dump("forced", force=True)
+        if dump_path:
+            record["flightrec_dump"] = dump_path
     if engine.executed_flops:
         record["flops_total"] = engine.executed_flops
         from alphafold2_tpu.observe.flops import mfu as _mfu
@@ -1071,14 +1276,20 @@ def bench_serve_async(emit: bool = True, tracer: Tracer | None = None) -> dict:
             file=sys.stderr,
         )
 
-    logger = _metrics_logger()
     if logger is not None:
         logger.log(0, stats)
         logger.log(0, {
             k: v for k, v in record.items()
             if isinstance(v, (int, float, str, bool))
         })
+        for v in slo_verdicts:  # slo/<spec>/<field> keys for obs_report
+            logger.log(0, {
+                f"slo/{v['spec']}/{k}": val for k, val in v.items()
+                if isinstance(val, (int, float, bool))
+            })
         MemorySampler().log_to(logger)
+    if metrics_server is not None:
+        metrics_server.stop()
     if owns_tracer:
         tracer.close()
     if emit:
@@ -1443,6 +1654,13 @@ if __name__ == "__main__":
     # math can account for time burned before a re-exec
     os.environ.setdefault("AF2TPU_BENCH_EPOCH0", str(time.time()))
 
+    # crash flight recorder (observe/flightrec.py): opt-in via
+    # AF2TPU_FLIGHTREC_DIR — rings of recent telemetry dumped as a
+    # scrubbed incident file on watchdog fire / dispatch error / SIGTERM
+    _flightrec_active = flightrec.maybe_install_from_env()
+    if _flightrec_active is not None:
+        flightrec.install_signal_handler(_flightrec_active)
+
     def _watchdog():
         # Backend init through the TPU tunnel can hang inside C++ with no
         # timeout; a daemon thread + os._exit is the only escape that still
@@ -1468,6 +1686,10 @@ if __name__ == "__main__":
     # minute (30s stage + 25s probe by default) instead of BENCH_r05's
     # silent 1500s burn; slow-but-alive => the stage earns another deadline
     def _on_liveness_dead(info: dict) -> None:
+        rec_fr = flightrec.active()
+        if rec_fr is not None:
+            # the incident file first: _emit + os._exit lose the rings
+            rec_fr.dump("liveness_dead", extra=dict(info))
         rec = _failure_record(
             f"backend liveness dead: phase '{info['stage']}' exceeded its "
             f"{info['stage_deadline_s']}s stage deadline and the backend "

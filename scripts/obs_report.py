@@ -11,8 +11,15 @@ cache-hit accounting (hit rate, compile seconds by shape) and HBM peaks.
 
 Pure host-side: imports no jax, initializes no backend — it must run on a
 laptop against artifacts scp'd from a TPU host (the reason MetricsLogger
-grew its ``enabled=`` override). Exits 0 on success, 2 on unreadable
-input, 1 on no input files.
+grew its ``enabled=`` override). Exits 0 on success, 1 on no input files,
+2 on unreadable input OR any truncated/malformed line (every parseable
+record is still reported; the malformed lines get a structured per-file
+summary on stderr instead of a mid-parse traceback — a killed writer's
+half-flushed tail must not hide the rest of the artifact).
+
+``--env`` echoes the AF2TPU_/JAX_/XLA_/TPU_ environment through the
+flight recorder's scrub (secret-shaped values redacted, AXON_ dropped),
+so a report pasted into a ticket carries the config without credentials.
 """
 
 from __future__ import annotations
@@ -23,8 +30,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from alphafold2_tpu.observe.flightrec import scrub_env
 from alphafold2_tpu.observe.histogram import Histogram
-from alphafold2_tpu.observe.tracing import load_trace_events
+from alphafold2_tpu.observe.tracectx import (
+    RESOLVE_EVENT,
+    SUBMIT_EVENT,
+    reconstruct_traces,
+    trace_incomplete_reason,
+)
+from alphafold2_tpu.observe.tracing import load_trace_events_lenient
 
 
 def _fmt_s(seconds: float) -> str:
@@ -49,12 +63,15 @@ def classify(path: str) -> str:
     return "trace" if "ph" in rec else "metrics"
 
 
-def report_trace(path: str) -> int:
-    events = load_trace_events(path)
+def report_trace(path: str) -> list:
+    """Span table + request-trace timelines. Returns the list of malformed-
+    line descriptions (empty = clean file) for main()'s error summary."""
+    events, errors = load_trace_events_lenient(path)
     spans = [e for e in events if e.get("ph") == "X"]
     print(f"== trace {path}: {len(events)} events, {len(spans)} spans ==")
     if not spans:
-        return 0
+        report_request_traces(events)
+        return errors
     by_name: dict = {}
     for e in spans:
         by_name.setdefault(e["name"], []).append(e.get("dur", 0.0) / 1e6)
@@ -84,7 +101,56 @@ def report_trace(path: str) -> int:
             args = e.get("args", {})
             shape = ", ".join(f"{k}={v}" for k, v in sorted(args.items()))
             print(f"  {e['name']}({shape}): {_fmt_s(e.get('dur', 0) / 1e6)}")
-    return 0
+    report_request_traces(events)
+    return errors
+
+
+def report_request_traces(events: list, max_shown: int = 8) -> None:
+    """Per-request lifecycle timelines reconstructed by trace_id: every
+    request whose sched.submit root rode this file, its event sequence in
+    ts order, its terminal status, and the completeness verdict (the same
+    trace_incomplete_reason the CI gate's trace_complete_fraction uses),
+    so a broken lifecycle names its missing link instead of just lowering
+    a fraction."""
+    traces = reconstruct_traces(events)
+    # request traces only: the trace must own a sched.submit root (shared
+    # batch spans list member trace_ids but belong to no single request)
+    roots = {
+        tid: evs for tid, evs in traces.items()
+        if any(
+            e.get("name") == SUBMIT_EVENT
+            and (e.get("args") or {}).get("trace_id") == tid
+            for e in evs
+        )
+    }
+    if not roots:
+        return
+    reasons = {
+        tid: trace_incomplete_reason(tid, evs) for tid, evs in roots.items()
+    }
+    n_ok = sum(1 for r in reasons.values() if r is None)
+    print(f"-- request traces ({n_ok}/{len(roots)} complete) --")
+    for i, tid in enumerate(sorted(roots)):
+        if i >= max_shown:
+            print(f"  ... {len(roots) - max_shown} more")
+            break
+        evs = sorted(roots[tid], key=lambda e: e.get("ts", 0))
+        steps = []
+        for e in evs:
+            name = e.get("name", "?")
+            if e.get("ph") == "X" and e.get("dur"):
+                steps.append(f"{name}({_fmt_s(e['dur'] / 1e6)})")
+            else:
+                steps.append(name)
+        status = next(
+            ((e.get("args") or {}).get("status") for e in reversed(evs)
+             if e.get("name") == RESOLVE_EVENT),
+            "?",
+        )
+        verdict = "complete" if reasons[tid] is None else reasons[tid]
+        print(f"  {tid[:12]} [{status}] {' > '.join(steps)}")
+        if reasons[tid] is not None:
+            print(f"    INCOMPLETE: {verdict}")
 
 
 def _fin(values):
@@ -274,13 +340,57 @@ def report_mesh(latest: dict) -> None:
             )
 
 
-def report_metrics(path: str) -> int:
-    records = []
+def report_slo(latest: dict) -> None:
+    """SLO section: the flattened ``slo/<spec>/<field>`` burn-rate keys a
+    serve-async bench logs per spec (bench.py), plus the headline alert
+    count — the multi-window verdicts the trace file carries as
+    ``slo.alert`` instant events."""
+    specs = sorted({
+        k.split("/", 2)[1] for k in latest
+        if k.startswith("slo/") and k.count("/") >= 2
+    })
+    if not specs and "slo_alerts" not in latest:
+        return
+    alerts = latest.get("slo_alerts")
+    head = f", {int(alerts)} alert(s) fired" if alerts else ""
+    print(f"-- SLO burn rates ({len(specs)} specs{head}) --")
+    for spec in specs:
+        def g(field, _s=spec):
+            return latest.get(f"slo/{_s}/{field}")
+        line = f"  {spec:<20}"
+        fast, slow = g("fast_burn"), g("slow_burn")
+        if fast is not None:
+            line += f" fast burn {fast:>6.2f}  slow burn {slow:>6.2f}"
+        bad, total = g("bad"), g("events")
+        if total:
+            line += f"  ({int(bad or 0)}/{int(total)} bad)"
+        if g("alert"):
+            line += "  ** ALERT **"
+        print(line)
+
+
+def report_metrics(path: str) -> list:
+    """Latest-value dump + per-domain sections. Returns the list of
+    malformed-line descriptions (empty = clean) for main()'s summary —
+    every parseable record is still reported."""
+    records, errors = [], []
     with open(path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, start=1):
             line = line.strip()
-            if line:
-                records.append(json.loads(line))
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"line {lineno}: {e.msg} ({line[:60]!r})")
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+            else:
+                errors.append(
+                    f"line {lineno}: record is "
+                    f"{type(rec).__name__}, not an object"
+                )
     print(f"== metrics {path}: {len(records)} records ==")
     latest: dict = {}
     for rec in records:
@@ -288,13 +398,16 @@ def report_metrics(path: str) -> int:
             if k not in ("step", "time"):
                 latest[k] = v
     for k in sorted(latest):
-        # per-tensor numerics stats and per-device HBM peaks are
-        # summarized by their sections below, not dumped key by key
-        if not k.startswith(("numerics/", "hbm/")):
+        # per-tensor numerics stats, per-device HBM peaks, SLO burn keys
+        # and registry-snapshot flags are summarized by their sections
+        # below, not dumped key by key
+        if not k.startswith(("numerics/", "hbm/", "slo/", "slo.")) \
+                and k != "registry":
             print(f"  {k} = {latest[k]}")
 
     report_train(records)
     report_scheduler(latest)
+    report_slo(latest)
     report_mesh(latest)
     report_kernels(latest)
 
@@ -310,24 +423,54 @@ def report_metrics(path: str) -> int:
     if "hbm_peak_bytes" in latest:
         print(f"-- memory --\n  HBM peak: "
               f"{latest['hbm_peak_bytes'] / 2**30:.3f} GiB")
-    return 0
+    return errors
+
+
+def report_env() -> None:
+    """The accelerator-relevant environment through the flight recorder's
+    scrub: AXON_ keys dropped, secret-named values redacted."""
+    print("== environment (scrubbed) ==")
+    for k, v in sorted(scrub_env().items()):
+        if k.startswith(("AF2TPU_", "JAX_", "XLA_", "TPU_", "LIBTPU")):
+            print(f"  {k}={v}")
 
 
 def main(argv=None) -> int:
-    paths = [a for a in (argv if argv is not None else sys.argv[1:])
-             if not a.startswith("-")]
+    args = list(argv if argv is not None else sys.argv[1:])
+    flags = [a for a in args if a.startswith("-")]
+    paths = [a for a in args if not a.startswith("-")]
+    if "--env" in flags:
+        report_env()
     if not paths:
+        if "--env" in flags:
+            return 0
         print(__doc__.strip().splitlines()[2].strip(), file=sys.stderr)
         return 1
     rc = 0
+    parse_errors: dict = {}
     for path in paths:
         try:
             kind = classify(path)
-            (report_trace if kind == "trace" else report_metrics)(path)
+            errs = (report_trace if kind == "trace" else report_metrics)(path)
+            if errs:
+                parse_errors[path] = errs
         except (OSError, json.JSONDecodeError) as e:
             print(f"ERROR reading {path}: {type(e).__name__}: {e}",
                   file=sys.stderr)
             rc = 2
+    if parse_errors:
+        # structured, machine-grepped by CI: one header, per-file counts,
+        # first few offending lines — and a nonzero exit so a truncated
+        # artifact fails the job instead of silently under-reporting
+        print("== PARSE ERRORS ==", file=sys.stderr)
+        for path, errs in parse_errors.items():
+            print(f"  {path}: {len(errs)} malformed line(s)",
+                  file=sys.stderr)
+            for err in errs[:5]:
+                print(f"    {err}", file=sys.stderr)
+            if len(errs) > 5:
+                print(f"    ... {len(errs) - 5} more", file=sys.stderr)
+        rc = 2
     return rc
 
 

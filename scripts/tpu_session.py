@@ -77,6 +77,17 @@ _FLUSH_LOCK = threading.Lock()
 # remaining stage names after the current one)
 _CURRENT: dict = {"stage": None, "start": 0.0, "remaining": []}
 
+# Flight recorder (observe.flightrec): incident dumps land beside the
+# session artifacts, so whatever scp collects TPU_SESSION.json collects
+# the scrubbed crash context too. An explicit AF2TPU_FLIGHTREC_DIR wins;
+# the default keeps dumps out of the repo tree's committed files.
+os.environ.setdefault(
+    "AF2TPU_FLIGHTREC_DIR", os.path.join(REPO, "incidents")
+)
+from alphafold2_tpu.observe import flightrec  # noqa: E402
+
+_FLIGHTREC = flightrec.maybe_install_from_env()
+
 
 def _flush():
     # the deadline watchdog and the stage loop may flush concurrently
@@ -84,6 +95,15 @@ def _flush():
         RESULTS["elapsed_seconds"] = round(time.monotonic() - _T0, 1)
         with open(OUT_PATH, "w") as f:
             json.dump(RESULTS, f, indent=2)
+
+
+def _dump_incident(reason: str, extra=None) -> None:
+    """Flight-recorder dump + surface the file path in RESULTS, so the
+    session summary names exactly what to scp after a truncated window.
+    Best-effort like everything else here (dump returns None on dup/IO)."""
+    path = _FLIGHTREC.dump(reason, extra=extra) if _FLIGHTREC else None
+    if path:
+        RESULTS.setdefault("incidents", []).append(path)
 
 
 # Stages that touch the (possibly tunneled) jax backend. After any backend
@@ -169,6 +189,9 @@ def _stage(name, fn):
             _flush()
             return
         _BACKEND["suspect"] = False  # tunnel came back; resume normally
+    if _FLIGHTREC:
+        # stage timeline in every later incident dump's notes ring
+        _FLIGHTREC.note("stage_start", stage=name)
     _CURRENT["stage"], _CURRENT["start"] = name, t0
     try:
         out = fn()
@@ -644,9 +667,18 @@ def main():
     # command from that mutable global (it would drop e.g. --no-rebaseline)
     flags = [a for a in sys.argv[1:] if a.startswith("-")]
 
+    if _FLIGHTREC:
+        # SIGTERM (window revoked, preemption): dump before the default
+        # handler kills the process
+        flightrec.install_signal_handler(_FLIGHTREC)
+
     def _watchdog():
         time.sleep(max(0.0, DEADLINE - (time.monotonic() - _T0)))
         RESULTS["deadline_exceeded"] = DEADLINE
+        _dump_incident(
+            "session_deadline",
+            extra={"deadline_s": DEADLINE, "stage": _CURRENT["stage"]},
+        )
         _flush()
         os._exit(75)  # nonzero: the session was truncated, not completed
 
@@ -671,6 +703,10 @@ def main():
                 "error": f"stage deadline {STAGE_DEADLINE}s exceeded "
                 "(hung tunnel?); relaunching for remaining stages",
             }
+            _dump_incident(
+                f"stage_deadline_{name}",
+                extra={"stage": name, "deadline_s": STAGE_DEADLINE},
+            )
             _flush()
             # retry the interrupted stage once in the relaunched session
             # (stages with checkpointing, e.g. train_real, resume where

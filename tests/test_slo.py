@@ -1,0 +1,245 @@
+"""SLO burn-rate monitor + metrics registry + Prometheus exposition
+(observe/slo.py, registry.py, exposition.py). All clock-driven logic runs
+on a fake clock — burn windows advance deterministically, no sleeps; the
+HTTP endpoint is exercised live once on an ephemeral loopback port."""
+
+import json
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from alphafold2_tpu.observe import Tracer
+from alphafold2_tpu.observe.exposition import (
+    MetricsHTTPServer,
+    render_prometheus,
+    serve_from_env,
+)
+from alphafold2_tpu.observe.registry import MetricsRegistry
+from alphafold2_tpu.observe.slo import (
+    SLOMonitor,
+    SLOSpec,
+    default_serve_slos,
+    parse_slo_specs,
+    priority_class,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def _result(status="ok", latency_s=0.01):
+    return SimpleNamespace(status=status, latency_s=latency_s)
+
+
+# ------------------------------------------------------------------ specs
+
+
+def test_spec_parsing_round_trip():
+    spec = SLOSpec.from_str(
+        "lat_hi,objective=latency,threshold_ms=500,target=0.95,class=high"
+    )
+    assert spec.name == "lat_hi" and spec.objective == "latency"
+    assert spec.threshold_ms == 500.0 and spec.priority_class == "high"
+    specs = parse_slo_specs(
+        "a,objective=latency,threshold_ms=1;b,objective=error_rate"
+    )
+    assert [s.name for s in specs] == ["a", "b"]
+    assert parse_slo_specs("") == []
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        SLOSpec(name="x", objective="nope")
+    with pytest.raises(ValueError):
+        SLOSpec(name="x", objective="latency")  # threshold required
+    with pytest.raises(ValueError):
+        SLOSpec(name="x", objective="availability", target=1.5)
+
+
+def test_default_serve_slos_cover_classes_and_objectives():
+    specs = default_serve_slos(deadline_s=30)
+    names = {s.name for s in specs}
+    assert {"latency_high", "latency_normal", "latency_low",
+            "error_rate", "deadline_miss"} <= names
+    assert priority_class(2) == "high"
+    assert priority_class(0) == "normal"
+    assert priority_class(-1) == "low"
+
+
+# ---------------------------------------------------------------- monitor
+
+
+def test_burn_rate_alert_fires_on_injected_latency():
+    clock = FakeClock()
+    registry = MetricsRegistry(clock=clock)
+    tracer = Tracer(enabled=True)
+    spec = SLOSpec(name="lat", objective="latency", threshold_ms=100,
+                   target=0.95, min_events=10)
+    mon = SLOMonitor([spec], registry=registry, clock=clock, tracer=tracer)
+
+    # healthy traffic first: no alert
+    for _ in range(20):
+        mon.observe(_result(latency_s=0.01))
+        clock.advance(0.5)
+    (verdict,) = mon.evaluate()
+    assert not verdict["alert"] and verdict["fast_burn"] == 0.0
+
+    # injected latency fault: every request breaches the threshold
+    for _ in range(20):
+        mon.observe(_result(latency_s=0.5))
+        clock.advance(0.5)
+    (verdict,) = mon.evaluate()
+    assert verdict["alert"], verdict
+    assert verdict["fast_burn"] >= spec.burn_threshold
+    assert verdict["slow_burn"] >= spec.burn_threshold
+    # the structured alert event fired exactly once (one-shot per spec)
+    mon.evaluate()
+    alerts = [e for e in tracer.events() if e["name"] == "slo.alert"]
+    assert len(alerts) == 1
+    assert alerts[0]["args"]["spec"] == "lat"
+
+
+def test_alert_needs_both_windows_burning():
+    """A single fast-window spike with a clean slow window must NOT alert
+    (the multi-window design exists to suppress blips)."""
+    clock = FakeClock()
+    registry = MetricsRegistry(clock=clock)
+    spec = SLOSpec(name="lat", objective="latency", threshold_ms=100,
+                   target=0.95, min_events=5, fast_window_s=10,
+                   slow_window_s=300)
+    mon = SLOMonitor([spec], registry=registry, clock=clock)
+    # long healthy history fills the slow window with goods
+    for _ in range(200):
+        mon.observe(_result(latency_s=0.01))
+        clock.advance(1.0)
+    # short burst of bads: fast window saturates, slow window diluted
+    for _ in range(8):
+        mon.observe(_result(latency_s=0.5))
+        clock.advance(0.5)
+    (verdict,) = mon.evaluate()
+    assert verdict["fast_burn"] >= spec.burn_threshold
+    assert verdict["slow_burn"] < spec.burn_threshold
+    assert not verdict["alert"]
+
+
+def test_class_scoped_spec_ignores_other_classes():
+    clock = FakeClock()
+    mon = SLOMonitor(
+        [SLOSpec(name="hi", objective="latency", threshold_ms=100,
+                 priority_class="high", min_events=1)],
+        registry=MetricsRegistry(clock=clock), clock=clock,
+    )
+    mon.observe(_result(latency_s=0.5), priority=0)  # normal: not counted
+    (v,) = mon.evaluate()
+    assert v["fast_events"] == 0
+    mon.observe(_result(latency_s=0.5), priority=2)  # high: counted, bad
+    (v,) = mon.evaluate()
+    assert v["fast_events"] == 1 and v["fast_burn"] > 0
+
+
+def test_rejections_excluded_from_error_rate_but_not_availability():
+    clock = FakeClock()
+    mon = SLOMonitor(
+        [SLOSpec(name="err", objective="error_rate", min_events=1),
+         SLOSpec(name="avail", objective="availability", min_events=1)],
+        registry=MetricsRegistry(clock=clock), clock=clock,
+    )
+    mon.observe(_result(status="rejected"))
+    err, avail = mon.evaluate()
+    assert err["fast_events"] == 0  # never dispatched: not an error event
+    assert avail["fast_events"] == 1 and avail["fast_burn"] > 0
+
+
+# --------------------------------------------------------------- registry
+
+
+def test_windowed_counter_sum_and_rate_with_fake_clock():
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    wc = reg.windowed_counter("hits")
+    for _ in range(10):
+        wc.add()
+        clock.advance(1.0)
+    assert wc.total == 10
+    assert wc.sum(5) == pytest.approx(5, abs=1)
+    clock.advance(1000.0)  # everything ages out of the windows
+    assert wc.sum(5) == 0
+    assert wc.total == 10  # lifetime total survives pruning
+
+
+def test_windowed_values_percentiles():
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    wv = reg.windowed_values("lat")
+    for v in range(1, 101):
+        wv.observe(float(v))
+    snap = wv.snapshot()
+    assert snap["p50"] == pytest.approx(50, abs=2)
+    assert snap["p99"] == pytest.approx(99, abs=2)
+    assert snap["max"] == 100
+
+
+def test_registry_snapshot_flattens_and_guards_kind():
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    reg.counter("n").inc(3)
+    reg.gauge("depth").set(7)
+    reg.windowed_counter("hits").add(2)
+    reg.windowed_values("lat").observe(1.0)
+    snap = reg.snapshot()
+    assert snap["n"] == 3 and snap["depth"] == 7
+    assert snap["hits.total"] == 2
+    assert any(k.startswith("lat.p") for k in snap)
+    with pytest.raises(ValueError):
+        reg.gauge("n")  # name already registered as a counter
+
+
+# ------------------------------------------------------------- exposition
+
+
+def test_render_prometheus_format():
+    text = render_prometheus(
+        {"serve.latency_ms.p95": 12.5, "sched.admitted": 4,
+         "9lives": 1, "skip_me": "not a number"}
+    )
+    lines = text.splitlines()
+    assert "af2tpu_serve_latency_ms_p95 12.5" in lines
+    assert "af2tpu_sched_admitted 4" in lines
+    assert any(ln.startswith("# TYPE af2tpu_serve_latency_ms_p95")
+               for ln in lines)
+    assert not any("skip_me" in ln for ln in lines)
+    assert any("_9lives" in ln for ln in lines)  # leading digit sanitized
+
+
+def test_metrics_http_server_live():
+    server = MetricsHTTPServer(
+        lambda: {"sched.admitted": 42}, port=0
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        body = urllib.request.urlopen(f"{base}/metrics", timeout=5).read()
+        assert b"af2tpu_sched_admitted 42" in body
+        health = json.loads(
+            urllib.request.urlopen(f"{base}/healthz", timeout=5).read()
+        )
+        assert health["ok"] is True
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+    finally:
+        server.stop()
+
+
+def test_serve_from_env_disabled_when_unset(monkeypatch):
+    monkeypatch.delenv("AF2TPU_METRICS_PORT", raising=False)
+    assert serve_from_env(lambda: {}) is None
+    monkeypatch.setenv("AF2TPU_METRICS_PORT", "")
+    assert serve_from_env(lambda: {}) is None

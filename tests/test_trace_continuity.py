@@ -1,0 +1,252 @@
+"""Trace continuity through the async scheduler (ISSUE 9 acceptance):
+every request lifecycle — plain dispatch, cache hit, in-flight dedup
+join, fault-injected retry, deadline miss, rejection — must reconstruct
+from the trace events alone to a complete submit→…→resolve chain keyed
+by the request's trace_id. Deterministic: fake clock, ``start=False``,
+FakeEngine from test_scheduler.py's pattern, memory tracer."""
+
+import numpy as np
+
+from alphafold2_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    ServeConfig,
+)
+from alphafold2_tpu.observe import EventCounters, Tracer
+from alphafold2_tpu.observe.tracectx import (
+    DEDUP_EVENT,
+    RESOLVE_EVENT,
+    SUBMIT_EVENT,
+    reconstruct_traces,
+    trace_completeness,
+)
+from alphafold2_tpu.serve import (
+    AsyncServeFrontend,
+    ServeRequest,
+    ServeResult,
+)
+
+
+def _cfg(buckets=(8, 16), max_batch=2, **serve_kw):
+    serve_kw.setdefault("mds_iters", 10)
+    return Config(
+        model=ModelConfig(dim=32, depth=1, heads=2, dim_head=16,
+                          max_seq_len=3 * max(buckets), bfloat16=False),
+        data=DataConfig(msa_depth=2),
+        serve=ServeConfig(buckets=buckets, max_batch=max_batch, **serve_kw),
+    )
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+class TracingFakeEngine:
+    """FakeEngine with the tracer ENABLED (memory mode) and trace_id
+    stamped on dispatched results the way ServeEngine does."""
+
+    def __init__(self, cfg, fail_first=0):
+        self.cfg = cfg
+        self.buckets = cfg.serve.buckets
+        self.max_batch = cfg.serve.max_batch
+        self.mesh_desc = None
+        self.counters = EventCounters()
+        self.tracer = Tracer(enabled=True)
+        self.dispatched = []
+        self._fail_remaining = fail_first
+
+    def batch_for(self, bucket):
+        return self.max_batch
+
+    def dispatch_batch(self, bucket, reqs):
+        self.dispatched.append((bucket, [r.seq for r in reqs]))
+        if self._fail_remaining > 0:
+            self._fail_remaining -= 1
+            return [
+                ServeResult(seq=r.seq, bucket=bucket, status="error",
+                            error="InjectedFault: boom",
+                            trace_id=r.trace.trace_id if r.trace else None)
+                for r in reqs
+            ]
+        return [
+            ServeResult(
+                seq=r.seq, bucket=bucket,
+                atom14=np.zeros((len(r.seq), 14, 3), np.float32),
+                latency_s=1e-3,
+                trace_id=r.trace.trace_id if r.trace else None,
+            )
+            for r in reqs
+        ]
+
+    def retry_bucket(self, bucket):
+        i = self.buckets.index(bucket)
+        return self.buckets[i + 1] if i + 1 < len(self.buckets) else None
+
+
+def _frontend(fail_first=0, **serve_kw):
+    serve_kw.setdefault("dwell_ms", 50.0)
+    eng = TracingFakeEngine(_cfg(**serve_kw), fail_first=fail_first)
+    clock = FakeClock()
+    fe = AsyncServeFrontend(eng, clock=clock, start=False)
+    return fe, eng, clock
+
+
+def _complete(tracer, results):
+    ids = [r.trace_id for r in results if r.status != "rejected"]
+    assert all(ids), results  # every non-rejected result is trace-stamped
+    return trace_completeness(tracer.events(), ids)
+
+
+# ------------------------------------------------------------- lifecycles
+
+
+def test_request_mints_trace_and_result_carries_it():
+    fe, eng, clock = _frontend()
+    req = ServeRequest("ACDEFG")
+    assert req.trace is not None  # minted at creation
+    h1, h2 = fe.submit(req), fe.submit("MKVLIT")
+    fe.pump()
+    r = h1.result(0)
+    assert r.ok and r.trace_id == req.trace.trace_id
+    summary = _complete(eng.tracer, [r, h2.result(0)])
+    assert summary == {"total": 2, "complete": 2, "fraction": 1.0}
+
+
+def test_dedup_join_links_follower_to_leader_trace():
+    fe, eng, clock = _frontend()
+    leader_req = ServeRequest("ACDEFG", seed=7)
+    follower_req = ServeRequest("ACDEFG", seed=7)  # same key, OWN trace
+    assert leader_req.trace.trace_id != follower_req.trace.trace_id
+    h1, h2 = fe.submit(leader_req), fe.submit(follower_req)
+    clock.advance(0.051)
+    fe.pump()
+    r1, r2 = h1.result(0), h2.result(0)
+    assert r1.ok and r2.ok and r2.cache_hit
+    # the shared result is re-stamped per requester: each trace resolves
+    assert r1.trace_id == leader_req.trace.trace_id
+    assert r2.trace_id == follower_req.trace.trace_id
+    summary = _complete(eng.tracer, [r1, r2])
+    assert summary["fraction"] == 1.0, summary
+    # the follower's join event names the leader trace it rode
+    joins = [e for e in eng.tracer.events() if e["name"] == DEDUP_EVENT]
+    assert len(joins) == 1
+    assert joins[0]["args"]["trace_id"] == follower_req.trace.trace_id
+    assert joins[0]["args"]["leader_trace"] == leader_req.trace.trace_id
+
+
+def test_cache_hit_lifecycle_reconstructs():
+    fe, eng, clock = _frontend()
+    first = ServeRequest("ACDEFG", seed=3)
+    fe.submit(first)
+    fe.submit("MKVLIT")
+    fe.pump()
+    repeat = ServeRequest("ACDEFG", seed=3)
+    r = fe.submit(repeat).result(0)
+    assert r.ok and r.cache_hit
+    assert r.trace_id == repeat.trace.trace_id  # NOT the first request's
+    summary = _complete(eng.tracer, [r])
+    assert summary["fraction"] == 1.0, summary
+
+
+def test_retry_lifecycle_reconstructs():
+    fe, eng, clock = _frontend(fail_first=1)
+    h1, h2 = fe.submit("ACDEFG"), fe.submit("MKVLIT")
+    fe.pump()
+    r1, r2 = h1.result(0), h2.result(0)
+    assert r1.ok and r1.retried and r2.ok
+    summary = _complete(eng.tracer, [r1, r2])
+    assert summary["fraction"] == 1.0, summary
+    # the retry span carries the member traces that rode it
+    retries = [e for e in eng.tracer.events() if e["name"] == "sched.retry"]
+    assert retries and set(retries[0]["args"]["trace_ids"]) == {
+        r1.trace_id, r2.trace_id
+    }
+
+
+def test_deadline_miss_lifecycle_reconstructs():
+    fe, eng, clock = _frontend(dwell_ms=10_000.0)
+    req = ServeRequest("ACDEFG", deadline_s=0.2)
+    h = fe.submit(req)
+    clock.advance(0.3)
+    fe.pump()
+    r = h.result(0)
+    assert r.status == "deadline_exceeded"
+    assert r.trace_id == req.trace.trace_id
+    summary = _complete(eng.tracer, [r])
+    assert summary["fraction"] == 1.0, summary
+
+
+def test_rejection_resolves_with_trace():
+    fe, eng, clock = _frontend(
+        queue_depth=1, dwell_ms=10_000.0, shed_watermark=0.0
+    )
+    fe.submit("ACDEFG")
+    rej = ServeRequest("MKVLIT")
+    r = fe.submit(rej).result(0)
+    assert r.status == "rejected"
+    assert r.trace_id == rej.trace.trace_id
+    # rejected requests still emit a submit root + resolve terminal
+    ids = [r.trace_id]
+    summary = trace_completeness(eng.tracer.events(), ids)
+    assert summary["fraction"] == 1.0, summary
+
+
+def test_fault_injected_run_reconstructs_every_lifecycle():
+    """The ISSUE's acceptance shape in miniature: mixed workload with an
+    injected dispatch fault — every non-rejected lifecycle complete."""
+    fe, eng, clock = _frontend(fail_first=1)
+    handles = []
+    reqs = ["ACDEFG", "MKVLIT", "ACDEFGHKLMNP", "WYTSAR", "GHKLMN"]
+    for i, seq in enumerate(reqs):
+        handles.append(fe.submit(ServeRequest(seq, seed=1, priority=i % 2)))
+        clock.advance(0.01)
+        fe.pump()
+    clock.advance(0.06)
+    fe.pump()
+    results = [h.result(0) for h in handles]
+    assert all(r.ok for r in results), [r.status for r in results]
+    summary = _complete(eng.tracer, results)
+    assert summary["fraction"] == 1.0, summary
+    # spot-check the event plumbing the reconstruction relies on
+    names = {e["name"] for e in eng.tracer.events()}
+    assert {SUBMIT_EVENT, RESOLVE_EVENT, "sched.dispatch"} <= names
+
+
+# -------------------------------------------------------------- observers
+
+
+def test_observers_see_every_resolution_with_priority():
+    fe, eng, clock = _frontend(
+        queue_depth=1, dwell_ms=10_000.0, shed_watermark=0.0
+    )
+    seen = []
+    fe.add_observer(lambda result, priority: seen.append(
+        (result.status, priority)))
+    fe.submit(ServeRequest("ACDEFG", priority=1), priority=1)
+    fe.submit("MKVLIT")  # queue full: rejected
+    clock.advance(11.0)
+    fe.pump()
+    statuses = sorted(seen)
+    assert ("rejected", 0) in statuses
+    assert ("ok", 1) in statuses
+    assert len(seen) == 2
+
+
+def test_observer_exception_does_not_break_resolution():
+    fe, eng, clock = _frontend()
+
+    def bad_observer(result, priority):
+        raise RuntimeError("observer bug")
+
+    fe.add_observer(bad_observer)
+    h1, h2 = fe.submit("ACDEFG"), fe.submit("MKVLIT")
+    fe.pump()
+    assert h1.result(0).ok and h2.result(0).ok
