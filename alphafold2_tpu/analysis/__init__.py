@@ -14,6 +14,12 @@ Three layers, each catching a class of defect before a chip runs it:
   primitive, input treedefs, donation map) diffed against the committed
   ``graph_contracts.json`` in CI, mirroring how ``observe/regress.py``
   gates runtime perf. CLI: ``python -m alphafold2_tpu.analysis.contracts``.
+- :mod:`hlo_audit` — one level below the jaxpr: compiles the registry
+  targets to optimized HLO and audits the *post-SPMD* graph — collective
+  census (count/bytes per all-reduce/all-gather/...), resharding
+  detection, and per-device memory vs the HBM budgets in :mod:`budgets`,
+  all diffed against the committed ``hlo_contracts.json``.
+  CLI: ``python -m alphafold2_tpu.analysis.hlo_audit --check``.
 
 Only :mod:`lint` is imported eagerly — it is jax-free so the lint CLI and
 CI job stay fast and backend-less. The trace-based layers import jax and
@@ -32,7 +38,9 @@ from alphafold2_tpu.analysis.lint import (
 __all__ = [
     "Finding",
     "RULES",
+    "budgets",
     "contracts",
+    "hlo_audit",
     "jaxpr_audit",
     "lint",
     "lint_file",
@@ -45,7 +53,14 @@ __all__ = [
 def __getattr__(name):
     # lazy: these import jax (and lowering additionally assumes a scrubbed
     # env when run as a gate) — keep `import alphafold2_tpu.analysis` cheap
-    if name in ("jaxpr_audit", "contracts", "lowering", "targets"):
+    if name in (
+        "jaxpr_audit",
+        "contracts",
+        "lowering",
+        "targets",
+        "hlo_audit",
+        "budgets",
+    ):
         import importlib
 
         return importlib.import_module(f"alphafold2_tpu.analysis.{name}")

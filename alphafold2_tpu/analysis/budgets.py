@@ -1,0 +1,180 @@
+"""Declared HBM budgets and verdicts for compiled executables.
+
+The "forgot-the-sharding cliff" is a *memory* cliff first: replicating the
+N^2 pair state onto every device multiplies the per-device footprint by the
+mesh size long before it shows up as a latency regression. Until now the
+only guard was a runtime bench threshold (``per_device_program_bytes`` at
+2x in observe/regress.py) — this module makes the figure a *static
+contract*: each audited target (analysis/targets.py) declares an
+``hbm_budget_bytes`` ceiling, the HLO audit (analysis/hlo_audit.py) reads
+the per-device footprint from XLA ``memory_analysis()`` at compile time,
+and :func:`check_budget` turns the pair into a three-way verdict:
+
+- ``pass``        — footprint measured and under budget (headroom reported)
+- ``over-budget`` — footprint measured and over; the gate fails (AF2A110)
+- ``no-data``     — no declared budget or no backend figure; the gate does
+                    not fail, but the verdict is loud so "we never gated
+                    this rung" can't masquerade as "this rung fits"
+
+:func:`lattice_report` extends the same contract to a live ServeEngine: it
+walks every (bucket, batch) rung the engine's ladder admits, compiles each
+(the engine's own AOT path, so records/counters ride along), and gates
+per-rung footprints against the device HBM — the offline pre-validation
+the compile-once roadmap item asks for before a lattice is persisted.
+
+Pure-stdlib except where a compiled executable is already in hand; no jax
+import at module scope so verdict logic is testable in milliseconds.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# Published per-chip HBM for device kinds the bench stack meets; the
+# serving budget is a fraction of this (XLA reserves workspace and the
+# runtime needs headroom for infeed/outfeed and donation churn).
+DEVICE_HBM_BYTES = {
+    "TPU v4": 32 << 30,
+    "TPU v5e": 16 << 30,
+    "TPU v5p": 95 << 30,
+    "TPU v6e": 32 << 30,
+}
+
+# Fraction of physical HBM a serve lattice may plan to; the rest is
+# runtime/workspace headroom.
+DEFAULT_HBM_FRACTION = 0.9
+
+
+def device_hbm_bytes(device=None) -> Optional[int]:
+    """Physical HBM of ``device`` (default: first jax device).
+
+    ``AF2TPU_HBM_BYTES`` overrides (the knob for CPU meshes and for
+    planning against a *smaller* chip than the one compiling). None when
+    the device kind is unknown — CPUs included, where "HBM" is
+    meaningless and the lattice report degrades to no-data verdicts.
+    """
+    env = os.environ.get("AF2TPU_HBM_BYTES")
+    if env:
+        return int(env)
+    try:
+        if device is None:
+            import jax
+
+            device = jax.devices()[0]
+        kind = device.device_kind
+        return next(
+            (v for k, v in DEVICE_HBM_BYTES.items()
+             if k.lower() in kind.lower()),
+            None,
+        )
+    except Exception:
+        return None
+
+
+def check_budget(
+    program_bytes: Optional[int], budget_bytes: Optional[int]
+) -> dict:
+    """Gate a measured per-device footprint against a declared budget.
+
+    Returns ``{"verdict", "program_bytes", "budget_bytes", ...}`` with
+    ``headroom_frac`` (fraction of budget still free; negative when over)
+    on measured verdicts and a ``reason`` on no-data ones. Never raises:
+    the verdict IS the error channel.
+    """
+    rec = {
+        "program_bytes": int(program_bytes) if program_bytes else None,
+        "budget_bytes": int(budget_bytes) if budget_bytes else None,
+    }
+    if not budget_bytes:
+        rec.update(verdict="no-data", reason="no declared hbm budget")
+        return rec
+    if not program_bytes:
+        rec.update(
+            verdict="no-data",
+            reason="backend exposes no memory_analysis figure",
+        )
+        return rec
+    rec["headroom_frac"] = round(1.0 - program_bytes / budget_bytes, 4)
+    rec["verdict"] = "pass" if program_bytes <= budget_bytes else "over-budget"
+    return rec
+
+
+def format_budget(name: str, rec: dict) -> str:
+    """One human line per verdict, bench_compare-style."""
+    pb, bb = rec.get("program_bytes"), rec.get("budget_bytes")
+    if rec["verdict"] == "no-data":
+        return f"{name}: no-data ({rec.get('reason', '?')})"
+    frac = rec.get("headroom_frac", 0.0)
+    return (
+        f"{name}: {rec['verdict']} — {pb} / {bb} bytes per device "
+        f"({frac:+.1%} headroom)"
+    )
+
+
+def lattice_report(
+    engine, hbm_bytes: Optional[int] = None,
+    hbm_fraction: float = DEFAULT_HBM_FRACTION,
+) -> dict:
+    """Pre-validate a ServeEngine's full (bucket, batch, mesh) executable
+    lattice offline: compile every rung the ladder admits and gate each
+    per-device footprint against ``hbm_fraction`` of the device HBM
+    (override with ``hbm_bytes``; unknown devices yield no-data verdicts).
+
+    Returns ``{"mesh", "hbm_budget_bytes", "rungs": [...], "verdict"}``
+    where the overall verdict is over-budget if ANY rung is.
+    """
+    from alphafold2_tpu.analysis.hlo_audit import collective_census
+    from alphafold2_tpu.observe.flops import (
+        executable_costs,
+        executable_memory,
+    )
+    from alphafold2_tpu.parallel.sharding import DATA_AXIS, describe_mesh
+
+    if hbm_bytes is None:
+        raw = device_hbm_bytes()
+        hbm_bytes = int(raw * hbm_fraction) if raw else None
+
+    rungs = []
+    for bucket in engine.buckets:
+        # same rung geometry as ServeEngine.warmup: padded dispatch batch,
+        # rounded up to the dp axis so shardings divide
+        batch = (
+            engine.batch_for(bucket) if engine.cfg.serve.pad_batches else 1
+        )
+        if engine.mesh is not None:
+            n_dp = dict(
+                zip(engine.mesh.axis_names, engine.mesh.devices.shape)
+            ).get(DATA_AXIS, 1)
+            batch += (-batch) % n_dp
+        compiled = engine._get_executable(bucket, batch)
+        memory = executable_memory(compiled)
+        costs = executable_costs(compiled)
+        census = {}
+        if engine.mesh is not None:
+            try:
+                census = collective_census(compiled.as_text())
+            except Exception:
+                census = {}
+        budget = check_budget(memory.get("program_bytes"), hbm_bytes)
+        rungs.append({
+            "bucket": int(bucket),
+            "batch": int(batch),
+            **memory,
+            "flops": costs.get("flops"),
+            "collectives": {k: v["count"] for k, v in census.items()},
+            "comm_bytes": sum(v["bytes"] for v in census.values()),
+            "budget": budget,
+        })
+    verdicts = {r["budget"]["verdict"] for r in rungs}
+    overall = (
+        "over-budget" if "over-budget" in verdicts
+        else "pass" if verdicts == {"pass"}
+        else "no-data"
+    )
+    return {
+        "mesh": describe_mesh(engine.mesh),
+        "hbm_budget_bytes": hbm_bytes,
+        "rungs": rungs,
+        "verdict": overall,
+    }

@@ -1,0 +1,496 @@
+"""Compiled-HLO audit: static comm/memory contracts for the serve lattice.
+
+The jaxpr auditor (:mod:`jaxpr_audit`) and the graph contracts
+(:mod:`contracts`) pin what WE wrote — the traced graph. This module pins
+what XLA actually *did* with it: the post-SPMD-partitioning optimized HLO,
+where the real scaling hazards of the N^2 pair trunk live. Three passes
+over each ``hlo=True`` target in the registry (analysis/targets.py):
+
+1. **Collective census** — count and classify every cross-device
+   collective (all-reduce / all-gather / reduce-scatter /
+   collective-permute / all-to-all) in the optimized module, estimate the
+   bytes each moves from its result shape, and report comm volume next to
+   the XLA FLOP count as a comm/compute ratio.
+2. **Resharding detector** — rules AF2A107–AF2A110: a dropped
+   ``shard_pair`` constraint surfaces as a named per-collective census
+   delta (AF2A107 drift), a fully-replicated "sharded" target or a
+   single-collective byte blowup (AF2A108), collectives appearing in a
+   target declared single-device (AF2A109).
+3. **Memory-budget contract** — the per-device footprint from XLA
+   ``memory_analysis()`` gated against the target's declared
+   ``hbm_budget_bytes`` (AF2A110, verdicts via analysis/budgets.py).
+
+Census + memory + budget verdicts are fingerprinted into a committed
+``hlo_contracts.json`` beside ``graph_contracts.json`` and diffed exactly:
+any collective appearing, disappearing, or changing size is a named,
+reviewed diff — caught at compile time on a laptop or in CI's 8-virtual-
+device mesh, with no bench run and no TPU.
+
+Byte estimates read the HLO *result* types: for all-gather that is the
+gathered (global) operand — the traffic a ring implementation actually
+moves per device up to the (P-1)/P factor — and for tuple-shaped
+all-to-alls the sum over tuple elements. They are contract figures
+(deterministic, comparable), not a performance model.
+
+Baselines are keyed by jax version AND device count; a mismatch reports
+``stale-baseline`` loudly without failing (exactly the graph-contract
+policy), so version bumps are explicit re-baselines, not red CI.
+
+CLI::
+
+    python -m alphafold2_tpu.analysis.hlo_audit --check
+    python -m alphafold2_tpu.analysis.hlo_audit --update
+    python -m alphafold2_tpu.analysis.hlo_audit --check --targets serve_fwd_long
+
+Exit codes: 0 clean (or stale-baseline, loudly), 1 findings/drift,
+2 missing baseline or usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Optional
+
+from alphafold2_tpu.analysis.budgets import check_budget, format_budget
+from alphafold2_tpu.analysis.jaxpr_audit import _finding
+
+FORMAT_VERSION = 1
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+    "hlo_contracts.json",
+)
+
+COLLECTIVE_KINDS = (
+    "all-gather",
+    "all-reduce",
+    "all-to-all",
+    "collective-permute",
+    "reduce-scatter",
+)
+
+# An HLO instruction line is "%name = <result type> <opcode>(operands...)";
+# requiring "(" right after the opcode keeps operand *references* to ops
+# named %all-gather.3 (never followed by "(") from matching, and the
+# -start/-done suffixes fold async pairs into one logical op.
+_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute"
+    r"|all-to-all)(-start|-done)?\("
+)
+_SHAPE_RE = re.compile(r"\b([a-z]+[0-9]*(?:\[[0-9,]*\]))")
+_NUM_PARTITIONS_RE = re.compile(r"num_partitions=(\d+)")
+
+DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8,
+}
+
+# Absolute backstop for the single-collective blowup rule when a target
+# declares no budget: no tiny audit target legitimately gathers a GiB.
+DEFAULT_BLOWUP_BYTES = 1 << 30
+
+
+# --------------------------------------------------------------- parsing
+
+
+def shape_bytes(token: str) -> int:
+    """Bytes of one HLO shape token like ``f32[2,48,48,32]`` (0 if the
+    token is not a shape; unknown dtypes assume 4 bytes)."""
+    m = re.match(r"([a-z]+[0-9]*)\[([0-9,]*)\]", token)
+    if not m:
+        return 0
+    dtype, dims = m.groups()
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * DTYPE_BYTES.get(dtype, 4)
+
+
+def parse_collectives(hlo_text: str) -> list:
+    """Every collective op in an optimized HLO module text, as
+    ``{"kind", "bytes"}`` dicts (bytes = result-shape size, summed over
+    tuple elements; async ``-done`` halves skipped so start/done pairs
+    count once)."""
+    ops = []
+    for line in hlo_text.splitlines():
+        if " = " not in line:
+            continue
+        rhs = line.split(" = ", 1)[1]
+        m = _COLLECTIVE_RE.search(rhs)
+        if not m or m.group(2) == "-done":
+            continue
+        nbytes = sum(
+            shape_bytes(tok) for tok in _SHAPE_RE.findall(rhs[: m.start()])
+        )
+        ops.append({"kind": m.group(1), "bytes": nbytes})
+    return ops
+
+
+def collective_census(hlo_text: str) -> dict:
+    """Aggregate :func:`parse_collectives` into
+    ``{kind: {"count", "bytes"}}``, kinds sorted for stable JSON."""
+    census: dict = {}
+    for op in parse_collectives(hlo_text):
+        d = census.setdefault(op["kind"], {"count": 0, "bytes": 0})
+        d["count"] += 1
+        d["bytes"] += op["bytes"]
+    return {k: census[k] for k in sorted(census)}
+
+
+def num_partitions(hlo_text: str) -> int:
+    """SPMD partition count from the HloModule header (1 if absent). The
+    header line can run to many KB (the entry layout rides on it), so
+    scan the whole text — the attribute only ever appears there."""
+    m = _NUM_PARTITIONS_RE.search(hlo_text)
+    return int(m.group(1)) if m else 1
+
+
+# ------------------------------------------------------------- recording
+
+
+def compile_target(target):
+    """AOT-compile one registry target the way the serve engine does
+    (lower at the example args, then compile)."""
+    import jax
+
+    fn, args = target.build()
+    return jax.jit(fn).lower(*args).compile()
+
+
+def hlo_record(target, compiled=None, hlo_text: Optional[str] = None) -> dict:
+    """The committed per-target contract record: census, comm/compute
+    ratio, per-device memory figures, and the budget verdict."""
+    from alphafold2_tpu.observe.flops import (
+        executable_costs,
+        executable_memory,
+    )
+
+    if compiled is None:
+        compiled = compile_target(target)
+    if hlo_text is None:
+        hlo_text = compiled.as_text()
+    census = collective_census(hlo_text)
+    memory = executable_memory(compiled)
+    flops = executable_costs(compiled)["flops"]
+    comm_bytes = int(sum(v["bytes"] for v in census.values()))
+    return {
+        "sharded": bool(target.sharded),
+        "num_partitions": num_partitions(hlo_text),
+        "collectives": census,
+        "collective_count": int(sum(v["count"] for v in census.values())),
+        "comm_bytes": comm_bytes,
+        "flops": flops,
+        "comm_bytes_per_flop": (
+            round(comm_bytes / flops, 8) if flops else None
+        ),
+        **memory,
+        "hbm_budget_bytes": target.hbm_budget_bytes,
+        "budget": check_budget(
+            memory.get("program_bytes"), target.hbm_budget_bytes
+        ),
+    }
+
+
+# --------------------------------------------------- structural rules
+
+
+def audit_record(name: str, rec: dict, per_op=None) -> list:
+    """Baseline-free structural rules over one contract record:
+    AF2A108 (sharded-but-replicated / single-collective blowup),
+    AF2A109 (collectives in a single-device target),
+    AF2A110 (per-device footprint over the declared HBM budget)."""
+    findings = []
+    n_coll = rec.get("collective_count", 0)
+    kinds = ", ".join(
+        f"{k} x{v['count']}" for k, v in rec.get("collectives", {}).items()
+    )
+    if not rec.get("sharded") and n_coll:
+        findings.append(_finding(
+            "AF2A109", name,
+            f"declared single-device but the optimized HLO contains "
+            f"{n_coll} cross-device collective(s): {kinds} — an implicit "
+            "resharding crept into an unsharded executable",
+        ))
+    if rec.get("sharded") and rec.get("num_partitions", 1) > 1 and not n_coll:
+        findings.append(_finding(
+            "AF2A108", name,
+            f"declared sharded and SPMD-partitioned "
+            f"{rec['num_partitions']} ways, yet the optimized HLO has "
+            "ZERO cross-device collectives — the sharding constraints "
+            "are inert and every device holds the fully replicated state",
+        ))
+    blowup = rec.get("hbm_budget_bytes") or DEFAULT_BLOWUP_BYTES
+    for op in per_op or ():
+        if op["bytes"] > blowup:
+            findings.append(_finding(
+                "AF2A108", name,
+                f"single {op['kind']} result is {op['bytes']} bytes "
+                f"(> {blowup}) — a replicated-operand blowup; some input "
+                "to this collective lost its sharding",
+            ))
+    budget = rec.get("budget", {})
+    if budget.get("verdict") == "over-budget":
+        findings.append(_finding(
+            "AF2A110", name,
+            "per-device footprint over declared HBM budget: "
+            + format_budget(name, budget),
+        ))
+    return findings
+
+
+# ------------------------------------------------------------ contracts
+
+
+def audit_hlo(targets=None) -> tuple:
+    """Compile every HLO-audited target and return
+    ``(contract_doc, structural_findings)``. Compile failures become
+    AF2A100 findings (the audit cannot certify what it cannot compile);
+    per-target ``allow`` waivers apply exactly as in the jaxpr audit."""
+    import jax
+
+    from alphafold2_tpu.analysis.targets import hlo_targets
+
+    doc = {
+        "format": FORMAT_VERSION,
+        "jax_version": jax.__version__,
+        "n_devices": len(jax.devices()),
+        "platform": jax.default_backend(),
+        "targets": {},
+    }
+    findings = []
+    for target in hlo_targets(targets):
+        try:
+            compiled = compile_target(target)
+            hlo_text = compiled.as_text()
+        except Exception as e:  # noqa: BLE001 — any compile failure gates
+            findings.append(_finding(
+                "AF2A100", target.name,
+                f"HLO compile failed: {type(e).__name__}: {e}"[:400],
+            ))
+            continue
+        rec = hlo_record(target, compiled, hlo_text)
+        doc["targets"][target.name] = rec
+        findings.extend(
+            f for f in audit_record(
+                target.name, rec, per_op=parse_collectives(hlo_text)
+            )
+            if f.rule not in target.allow
+        )
+    return doc, findings
+
+
+def _diff_record(name: str, base: dict, cur: dict) -> list:
+    lines = []
+    bcoll = base.get("collectives", {})
+    ccoll = cur.get("collectives", {})
+    for kind in sorted(set(bcoll) | set(ccoll)):
+        b = bcoll.get(kind, {"count": 0, "bytes": 0})
+        c = ccoll.get(kind, {"count": 0, "bytes": 0})
+        if b["count"] != c["count"]:
+            lines.append(
+                f"{name}: {kind} count drift: {b['count']} -> "
+                f"{c['count']} ({c['count'] - b['count']:+d})"
+            )
+        if b["bytes"] != c["bytes"]:
+            lines.append(
+                f"{name}: {kind} bytes drift: {b['bytes']} -> "
+                f"{c['bytes']} ({c['bytes'] - b['bytes']:+d})"
+            )
+    for field in (
+        "sharded", "num_partitions", "comm_bytes", "flops",
+        "argument_bytes", "output_bytes", "temp_bytes",
+    ):
+        if base.get(field) != cur.get(field):
+            lines.append(
+                f"{name}: {field} drift: {base.get(field)} -> "
+                f"{cur.get(field)}"
+            )
+    bpb, cpb = base.get("program_bytes"), cur.get("program_bytes")
+    if bpb != cpb:
+        ratio = f" ({cpb / bpb:.2f}x)" if bpb and cpb else ""
+        lines.append(
+            f"{name}: per-device program_bytes drift: {bpb} -> "
+            f"{cpb}{ratio}"
+        )
+    bver = base.get("budget", {}).get("verdict")
+    cver = cur.get("budget", {}).get("verdict")
+    if bver != cver:
+        lines.append(f"{name}: budget verdict drift: {bver} -> {cver}")
+    return lines
+
+
+def diff_hlo_contracts(baseline: dict, current: dict,
+                       subset: bool = False) -> list:
+    """Exact per-collective drift lines between two contract docs.
+    ``subset=True`` restricts to targets present in ``current`` (a
+    ``--targets`` run), so unaudited targets don't read as removed."""
+    bt = baseline.get("targets", {})
+    ct = current.get("targets", {})
+    names = sorted(set(ct) if subset else set(bt) | set(ct))
+    lines = []
+    for name in names:
+        if name not in bt:
+            lines.append(
+                f"{name}: NEW TARGET (not in baseline) — re-baseline with "
+                "--update after review"
+            )
+        elif name not in ct:
+            lines.append(
+                f"{name}: missing from current audit (target removed or "
+                "failed to compile)"
+            )
+        else:
+            lines.extend(_diff_record(name, bt[name], ct[name]))
+    return lines
+
+
+def check_against(baseline_path: str, current: dict,
+                  subset: bool = False) -> dict:
+    """Gate a freshly computed doc against the committed baseline.
+    Verdicts: ``missing-baseline`` / ``stale-baseline`` (jax version,
+    device count or format changed — loud, not failing, exactly the
+    graph-contract policy) / ``drift`` / ``pass``."""
+    if not os.path.exists(baseline_path):
+        return {
+            "verdict": "missing-baseline",
+            "reason": f"no baseline at {baseline_path}; run --update",
+        }
+    with open(baseline_path, encoding="utf-8") as fh:
+        baseline = json.load(fh)
+    for key in ("format", "jax_version", "n_devices", "platform"):
+        if baseline.get(key) != current.get(key):
+            return {
+                "verdict": "stale-baseline",
+                "reason": (
+                    f"RECOMPILE KEY {key}: baseline "
+                    f"{baseline.get(key)!r} vs current "
+                    f"{current.get(key)!r}; re-baseline with --update"
+                ),
+            }
+    drift = diff_hlo_contracts(baseline, current, subset=subset)
+    return {
+        "verdict": "drift" if drift else "pass",
+        "drift": drift,
+    }
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = parser.add_mutually_exclusive_group(required=True)
+    mode.add_argument(
+        "--check", action="store_true",
+        help="audit + diff against the committed baseline",
+    )
+    mode.add_argument(
+        "--update", action="store_true",
+        help="audit + rewrite the baseline (a reviewed re-baseline)",
+    )
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE)
+    parser.add_argument(
+        "--targets", default=None,
+        help="comma-separated target subset (default: all hlo=True)",
+    )
+    parser.add_argument(
+        "--json", dest="json_path", default=None,
+        help="write the full result (doc + check + findings) here",
+    )
+    args = parser.parse_args(argv)
+
+    from alphafold2_tpu.analysis.targets import (
+        default_targets,
+        hlo_targets,
+    )
+
+    registry = default_targets()
+    subset = None
+    if args.targets:
+        names = [s.strip() for s in args.targets.split(",") if s.strip()]
+        known = {t.name for t in hlo_targets(registry)}
+        unknown = set(names) - known
+        if unknown:
+            print(
+                f"unknown hlo target(s): {sorted(unknown)}; "
+                f"known: {sorted(known)}",
+                file=sys.stderr,
+            )
+            return 2
+        subset = [t for t in registry if t.name in names]
+
+    doc, findings = audit_hlo(subset if subset is not None else registry)
+
+    check = None
+    if args.update:
+        if not findings:
+            with open(args.baseline, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=2, sort_keys=True)
+                fh.write("\n")
+            print(
+                f"hlo-contracts: wrote {args.baseline} "
+                f"({len(doc['targets'])} targets, "
+                f"n_devices={doc['n_devices']})"
+            )
+        else:
+            # never pin a violating surface as the reviewed baseline
+            print(
+                "hlo-contracts: REFUSING to baseline a surface with "
+                f"{len(findings)} structural finding(s)"
+            )
+    else:
+        check = check_against(
+            args.baseline, doc, subset=subset is not None
+        )
+        for line in check.get("drift", []):
+            print(f"hlo-contract DRIFT: {line}")
+        if check["verdict"] == "drift":
+            findings.append(_finding(
+                "AF2A107", "hlo_contracts",
+                f"{len(check['drift'])} contract drift line(s) vs "
+                f"{os.path.basename(args.baseline)}; intended? "
+                "re-baseline with --update",
+            ))
+        elif check["verdict"] == "missing-baseline":
+            findings.append(
+                _finding("AF2A107", "hlo_gate", check["reason"])
+            )
+        elif check["verdict"] == "stale-baseline":
+            print(f"hlo-contracts: STALE BASELINE — {check['reason']}")
+
+    for f in findings:
+        print(f.format())
+    summary = {
+        "gate": "hlo",
+        "verdict": (
+            check["verdict"] if check is not None
+            else ("fail" if findings else "updated")
+        ),
+        "n_targets": len(doc["targets"]),
+        "findings": [f.to_dict() for f in findings],
+    }
+    print(json.dumps(summary))
+    if args.json_path:
+        with open(args.json_path, "w", encoding="utf-8") as fh:
+            json.dump(
+                {"doc": doc, "check": check, "summary": summary},
+                fh, indent=2, sort_keys=True,
+            )
+            fh.write("\n")
+
+    if check is not None and check["verdict"] == "missing-baseline":
+        return 2
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,13 +26,28 @@ forward) on the host — no device, no compile — and statically rejects:
 
 Rule ``AF2A106`` (Mosaic TPU lowering failure) folds the Pallas lowering
 gate (:mod:`alphafold2_tpu.analysis.lowering`, formerly the whole of
-``scripts/check_tpu_lowering.py``) into the same findings stream: ``--rules
-jaxpr,lowering`` is the single pre-hardware gate entry point.
+``scripts/check_tpu_lowering.py``) into the same findings stream, and the
+``hlo`` rule set folds in the compiled-HLO audit
+(:mod:`alphafold2_tpu.analysis.hlo_audit`) — collective census drift vs
+the committed ``hlo_contracts.json`` (``AF2A107``), sharded-but-replicated
+/ collective blowups (``AF2A108``), collectives in single-device targets
+(``AF2A109``) and per-device HBM budget breaches (``AF2A110``) — so
+``--rules jaxpr,lowering,hlo`` is the single pre-hardware gate entry point
+the first TPU session runs before anything burns bench time.
+
+Traversal note: rule scans walk :func:`iter_eqns_deep`, which additionally
+recurses into ``custom_vjp``/``custom_jvp`` forward AND backward bodies
+(traced on the spot from the stored thunks) — a host callback or f64
+widening hiding inside a custom-VJP closure (e.g. a Pallas kernel's
+backward) cannot pass silently. :func:`iter_eqns` keeps the historical
+shallow-ish traversal because the graph-contract fingerprints
+(:mod:`contracts`) are built on it; changing it would re-key every
+committed contract.
 
 CLI::
 
     JAX_PLATFORMS=cpu python -m alphafold2_tpu.analysis.jaxpr_audit \
-        [--targets model_fwd,train_step] [--rules jaxpr,lowering] \
+        [--targets model_fwd,train_step] [--rules jaxpr,lowering,hlo] \
         [--const-threshold BYTES] [--json out.json]
 
 Exit codes: 0 clean, 1 findings, 2 usage error. Targets may waive specific
@@ -53,6 +68,10 @@ AUDIT_RULES = {
     "AF2A104": ("warning", "declared donation can never alias"),
     "AF2A105": ("error", "strict dtype promotion violation"),
     "AF2A106": ("error", "Mosaic TPU lowering failure"),
+    "AF2A107": ("error", "HLO collective-census/contract drift"),
+    "AF2A108": ("error", "sharded target replicated / collective blowup"),
+    "AF2A109": ("error", "collectives in a single-device target"),
+    "AF2A110": ("error", "per-device footprint over HBM budget"),
 }
 
 FORBIDDEN_PRIMITIVES = {
@@ -110,11 +129,146 @@ def _sub_jaxprs(params: dict):
 
 def iter_eqns(jaxpr) -> Iterable:
     """Every equation in ``jaxpr``, recursing into call/control-flow
-    sub-jaxprs (scan bodies, cond branches, pjit calls, remat)."""
+    sub-jaxprs (scan bodies, cond branches, pjit calls, remat).
+
+    This is the traversal the graph-contract fingerprints (:mod:`contracts`)
+    are keyed on — keep it stable; rule scans use :func:`iter_eqns_deep`."""
     for eqn in jaxpr.eqns:
         yield eqn
         for sub in _sub_jaxprs(eqn.params):
             yield from iter_eqns(sub)
+
+
+def _custom_vjp_bodies(eqn, failures: Optional[list] = None):
+    """The fwd and bwd bodies of a ``custom_vjp_call`` equation.
+
+    ``_sub_jaxprs`` only sees the primal ``fun_jaxpr`` — exactly the body a
+    custom VJP *replaces* under differentiation. The real fwd is stored as
+    ``fwd_jaxpr_thunk`` (called with one tangent-nonzero flag per
+    non-const input — all True is the generic jvp) and the bwd as the raw
+    ``bwd`` callable, which we trace at the fwd's (residual, cotangent)
+    avals (the fwd jaxpr returns residuals first, primal outputs last).
+    Anything untraceable is recorded in ``failures`` instead of silently
+    skipped — an unauditable closure must surface as a finding, not read
+    as clean."""
+    params = eqn.params
+    thunk = params.get("fwd_jaxpr_thunk")
+    if thunk is None:
+        return
+    n_primal = len(eqn.outvars)
+    n_flags = len(eqn.invars) - params.get("num_consts", 0)
+    try:
+        fwd_jaxpr = thunk(*([True] * n_flags))[0]
+    except Exception as e:
+        if failures is not None:
+            failures.append(
+                f"custom_vjp fwd body untraceable: {type(e).__name__}: "
+                f"{str(e)[:200]}"
+            )
+        return
+    yield fwd_jaxpr
+    bwd = params.get("bwd")
+    if bwd is None:
+        return
+    try:
+        import jax
+
+        outs = [v.aval for v in fwd_jaxpr.outvars]
+        res_avals = outs[:-n_primal] if n_primal else outs
+        ct_avals = outs[-n_primal:] if n_primal else []
+        closed = jax.make_jaxpr(lambda *a: bwd(*a))(*[
+            jax.ShapeDtypeStruct(a.shape, a.dtype)
+            for a in list(res_avals) + list(ct_avals)
+        ])
+        yield closed.jaxpr
+    except Exception as e:
+        if failures is not None:
+            failures.append(
+                f"custom_vjp bwd body untraceable: {type(e).__name__}: "
+                f"{str(e)[:200]}"
+            )
+
+
+def _custom_jvp_bodies(eqn, failures: Optional[list] = None):
+    """The jvp body of a ``custom_jvp_call`` equation: the memoized
+    ``jvp_jaxpr_thunk`` takes one *symbolic-zero* flag per non-const input
+    (NOTE: inverted vs the vjp thunk's nonzero flags — all False is the
+    generic every-tangent-live case) and returns ``(jaxpr, consts, ...)``.
+    Failures are recorded so an unauditable closure surfaces instead of
+    passing silently."""
+    params = eqn.params
+    thunk = params.get("jvp_jaxpr_thunk")
+    if thunk is None:
+        return
+    n_flags = len(eqn.invars) - params.get("num_consts", 0)
+    try:
+        jvp_jaxpr = thunk(*([False] * n_flags))[0]
+    except Exception as e:
+        if failures is not None:
+            failures.append(
+                f"custom_jvp body untraceable: {type(e).__name__}: "
+                f"{str(e)[:200]}"
+            )
+        return
+    yield jvp_jaxpr
+
+
+def _eqn_signature(eqn) -> tuple:
+    """Structural identity of a custom_vjp/jvp call site: the standard
+    pattern (``f_fwd`` calling ``f(x)``) re-embeds the SAME custom call in
+    its own fwd body, so expansion must dedupe by signature or it recurses
+    forever — each thunk call builds a fresh jaxpr, so object identity
+    cannot terminate it."""
+    return (
+        eqn.primitive.name,
+        tuple(str(getattr(v, "aval", v)) for v in eqn.invars),
+        tuple(str(getattr(v, "aval", v)) for v in eqn.outvars),
+    )
+
+
+def _deep_sub_jaxprs(eqn, failures: Optional[list] = None,
+                     seen: Optional[set] = None):
+    """Everything :func:`_sub_jaxprs` yields, plus dict-valued params and
+    the custom_vjp/custom_jvp fwd/bwd/jvp bodies (expanded once per call
+    signature)."""
+    from jax.extend import core as jex_core
+
+    yield from _sub_jaxprs(eqn.params)
+    for value in eqn.params.values():
+        if isinstance(value, dict):
+            for v in value.values():
+                if isinstance(v, jex_core.ClosedJaxpr):
+                    yield v.jaxpr
+                elif isinstance(v, jex_core.Jaxpr):
+                    yield v
+    name = eqn.primitive.name
+    if not (name.startswith("custom_vjp_call")
+            or name.startswith("custom_jvp_call")):
+        return
+    sig = _eqn_signature(eqn)
+    if seen is not None:
+        if sig in seen:
+            return
+        seen.add(sig)
+    if name.startswith("custom_vjp_call"):
+        yield from _custom_vjp_bodies(eqn, failures)
+    else:
+        yield from _custom_jvp_bodies(eqn, failures)
+
+
+def iter_eqns_deep(jaxpr, failures: Optional[list] = None) -> Iterable:
+    """:func:`iter_eqns` plus recursion into custom_vjp/custom_jvp bodies;
+    untraceable bodies append a reason to ``failures`` (when given) so the
+    caller can refuse to certify what it could not walk."""
+    seen: set = set()
+
+    def rec(jx):
+        for eqn in jx.eqns:
+            yield eqn
+            for sub in _deep_sub_jaxprs(eqn, failures, seen):
+                yield from rec(sub)
+
+    yield from rec(jaxpr)
 
 
 def _aval_dtypes(eqn):
@@ -133,13 +287,19 @@ def audit_closed_jaxpr(
     target: str = "<jaxpr>",
     const_threshold: int = DEFAULT_CONST_THRESHOLD,
 ) -> list:
-    """Pure jaxpr rules (AF2A101/102/103) over an already-traced graph."""
+    """Pure jaxpr rules (AF2A101/102/103) over an already-traced graph.
+
+    Walks :func:`iter_eqns_deep`, so hits inside custom_vjp/custom_jvp
+    closures count (possibly twice — a primal body shared by the fwd is
+    walked in both; the count is a locator, not an exact census). A body
+    the walker could not trace becomes an AF2A100 finding."""
     import numpy as np
 
     findings: list = []
     wide_hits: dict = {}
     callback_hits: dict = {}
-    for eqn in iter_eqns(closed.jaxpr):
+    trace_failures: list = []
+    for eqn in iter_eqns_deep(closed.jaxpr, trace_failures):
         name = eqn.primitive.name
         if name in FORBIDDEN_PRIMITIVES:
             callback_hits[name] = callback_hits.get(name, 0) + 1
@@ -152,6 +312,11 @@ def audit_closed_jaxpr(
         for dtype in _aval_dtypes(eqn):
             if dtype in WIDE_DTYPES:
                 wide_hits[dtype] = wide_hits.get(dtype, 0) + 1
+    for why in sorted(set(trace_failures)):
+        findings.append(_finding(
+            "AF2A100", target,
+            f"cannot audit a closed-over body: {why}",
+        ))
     for what, count in sorted(wide_hits.items()):
         findings.append(_finding(
             "AF2A101", target,
@@ -342,6 +507,67 @@ def lowering_findings(case_names=None) -> list:
     return findings
 
 
+# ------------------------------------------------------------ hlo rule set
+
+
+def hlo_findings(target_names=None) -> list:
+    """Run the compiled-HLO audit (analysis.hlo_audit --check) in a
+    scrubbed subprocess pinned to the CPU backend with 8 virtual devices —
+    the same device count the committed ``hlo_contracts.json`` is keyed by
+    — and fold its findings (AF2A107–110) into this stream.
+
+    A subprocess for the same reason as the lowering gate: the parent may
+    already hold a differently-sized backend, and device count is part of
+    the contract key. A gate that produces no summary is itself an
+    AF2A107 finding — a refusal to certify must never read as green."""
+    import subprocess
+    import sys
+
+    from alphafold2_tpu.preflight import scrub_axon_env
+
+    env = scrub_axon_env()
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    cmd = [sys.executable, "-m", "alphafold2_tpu.analysis.hlo_audit",
+           "--check"]
+    if target_names:
+        cmd += ["--targets", ",".join(target_names)]
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, env=env, timeout=1800
+    )
+    summary = None
+    for line in proc.stdout.splitlines():
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("gate") == "hlo":
+            summary = rec
+    if summary is None:
+        return [_finding(
+            "AF2A107", "hlo_gate",
+            f"hlo gate produced no summary record (rc={proc.returncode}); "
+            f"stderr tail: {proc.stderr[-300:]}",
+        )]
+    if summary.get("verdict") == "stale-baseline":
+        print(
+            "jaxpr_audit: hlo gate reports a STALE baseline "
+            "(recompile key changed) — re-baseline hlo_contracts.json"
+        )
+    return [
+        AuditFinding(
+            rec["rule"], rec["severity"], rec["target"], rec["message"]
+        )
+        for rec in summary.get("findings", [])
+    ]
+
+
 # --------------------------------------------------------------------- CLI
 
 
@@ -373,7 +599,10 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--rules", default="jaxpr",
-        help="comma-separated rule sets: jaxpr, lowering (default: jaxpr)",
+        help=(
+            "comma-separated rule sets: jaxpr, lowering, hlo "
+            "(default: jaxpr)"
+        ),
     )
     parser.add_argument(
         "--const-threshold", type=int, default=DEFAULT_CONST_THRESHOLD
@@ -382,7 +611,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     rule_sets = {s.strip() for s in args.rules.split(",") if s.strip()}
-    unknown = rule_sets - {"jaxpr", "lowering"}
+    unknown = rule_sets - {"jaxpr", "lowering", "hlo"}
     if unknown:
         print(f"unknown rule set(s): {sorted(unknown)}")
         return 2
@@ -403,6 +632,8 @@ def main(argv=None) -> int:
         findings.extend(audit(targets, args.const_threshold))
     if "lowering" in rule_sets:
         findings.extend(lowering_findings())
+    if "hlo" in rule_sets:
+        findings.extend(hlo_findings())
 
     for f in findings:
         print(f.format())

@@ -32,6 +32,27 @@ Rules (id, severity):
 - ``AF2L009`` warning — host side effect under trace (counter ``.bump`` /
   histogram ``.observe`` / ``logging``): runs per *trace*, not per step.
 
+Threaded-serve rules (the async frontend runs a dispatcher thread next to
+caller threads; these rules lint the locking discipline of any class that
+creates a ``threading`` lock):
+
+- ``AF2L010`` error — blocking call (``time.sleep``, file/socket/
+  subprocess I/O) while holding a lock: every other thread stalls behind
+  the critical section. ``.wait()`` is exempt — ``Condition.wait``
+  *releases* the lock by design.
+- ``AF2L011`` warning — an attribute that is mutated under the class's
+  lock somewhere is mutated *outside* it elsewhere (``__init__``
+  excepted): either the lock is unnecessary or the unlocked write is a
+  race.
+- ``AF2L012`` error — host sync (``device_get`` / ``.item()`` /
+  ``.block_until_ready()`` / ``np.asarray``) directly in a function used
+  as a ``threading.Thread`` target: the dispatcher thread exists to keep
+  the device pipeline full, and a sync in its body serializes it.
+
+Like everything here these are syntactic: AF2L011 sees direct ``self.x``
+mutations (not aliases), AF2L012 sees the thread body function itself (no
+call graph). The reviewable-by-grep class of bug, no more.
+
 A *jit context* is a function that is (a) decorated with ``jax.jit`` /
 ``jit`` / ``partial(jax.jit, ...)``, (b) passed to a ``*.jit(...)`` call
 anywhere in the same module (``jax.jit(step, ...)``, ``jax.jit(self._fwd,
@@ -72,6 +93,9 @@ RULES = {
     "AF2L007": ("warning", "traced param needs static_argnames"),
     "AF2L008": ("warning", "print under jit"),
     "AF2L009": ("warning", "host side effect under jit"),
+    "AF2L010": ("error", "blocking call while holding a lock"),
+    "AF2L011": ("warning", "lock-guarded state mutated outside its lock"),
+    "AF2L012": ("error", "host sync in a thread body"),
 }
 
 _NOQA_RE = re.compile(r"#\s*af2:\s*noqa(?:\[([A-Za-z0-9_,\s]+)\])?")
@@ -534,12 +558,281 @@ class _Linter(ast.NodeVisitor):
             )
 
 
+# ------------------------------------------------- thread-safety rules
+
+_LOCK_FACTORIES = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+}
+# calls that block the calling thread (module.attr chains)
+_BLOCKING_CHAIN_HEADS = {"socket", "subprocess", "requests", "urllib"}
+_BLOCKING_CALLS = {
+    ("time", "sleep"), ("os", "system"), ("os", "popen"),
+}
+# socket/file methods that block regardless of the receiver expression;
+# .wait() is deliberately absent (Condition.wait releases the lock)
+_BLOCKING_METHODS = {
+    "recv", "recvfrom", "sendall", "sendto", "connect", "accept",
+    "read_text", "write_text", "readline", "readlines",
+}
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "remove", "pop", "popleft",
+    "appendleft", "clear", "update", "setdefault", "add", "discard",
+}
+_HOST_SYNC_CALLS = {"device_get", "block_until_ready"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.x`` -> "x" (None for anything else)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One pass over a method body tracking ``with self.<lock>:`` depth;
+    records self-attribute mutations (with lock state) and blocking calls
+    made while a lock is held."""
+
+    def __init__(self, lock_attrs: set, assume_held: bool = False):
+        self.lock_attrs = lock_attrs
+        # the *_locked naming convention documents "caller holds the
+        # lock": treat the whole body as a critical section, which both
+        # exempts its mutations from AF2L011 and (correctly) arms
+        # AF2L010 for blocking calls inside it
+        self.lock_depth = 1 if assume_held else 0
+        self.mutations: list = []  # (attr, node, held: bool)
+        self.blocking: list = []  # (node, description)
+
+    def _is_lock_expr(self, node: ast.AST) -> bool:
+        attr = _self_attr(node)
+        return attr is not None and attr in self.lock_attrs
+
+    def visit_With(self, node: ast.With):
+        held = sum(
+            1 for item in node.items if self._is_lock_expr(item.context_expr)
+        )
+        self.lock_depth += held
+        try:
+            self.generic_visit(node)
+        finally:
+            self.lock_depth -= held
+
+    def _mutation(self, attr: Optional[str], node: ast.AST):
+        if attr is not None:
+            self.mutations.append((attr, node, self.lock_depth > 0))
+
+    def _mutated_attr_of_target(self, target: ast.AST) -> Optional[str]:
+        attr = _self_attr(target)
+        if attr is not None:
+            return attr
+        if isinstance(target, ast.Subscript):
+            return _self_attr(target.value)
+        return None
+
+    def visit_Assign(self, node: ast.Assign):
+        for target in node.targets:
+            elts = target.elts if isinstance(
+                target, (ast.Tuple, ast.List)
+            ) else [target]
+            for t in elts:
+                self._mutation(self._mutated_attr_of_target(t), node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._mutation(self._mutated_attr_of_target(node.target), node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        for target in node.targets:
+            self._mutation(self._mutated_attr_of_target(target), node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        # self.<attr>.<mutator>(...) counts as a mutation of self.<attr>
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATING_METHODS:
+                self._mutation(_self_attr(node.func.value), node)
+        if self.lock_depth > 0:
+            desc = self._blocking_desc(node)
+            if desc:
+                self.blocking.append((node, desc))
+        self.generic_visit(node)
+
+    @staticmethod
+    def _blocking_desc(node: ast.Call) -> Optional[str]:
+        chain = _attr_chain(node.func)
+        if not chain:
+            return None
+        if chain == ["open"]:
+            return "open"
+        if tuple(chain) in _BLOCKING_CALLS:
+            return ".".join(chain)
+        if len(chain) >= 2 and chain[0] in _BLOCKING_CHAIN_HEADS:
+            return ".".join(chain)
+        if len(chain) >= 2 and chain[-1] in _BLOCKING_METHODS:
+            return ".".join(chain)
+        return None
+
+
+class _ThreadSafetyLinter:
+    """AF2L010–012 over one parsed module (see the module docstring for
+    what each rule sees — and honestly does not see)."""
+
+    def __init__(self, path: str, tree: ast.Module, noqa: dict):
+        self.path = path
+        self.tree = tree
+        self.noqa = noqa
+        self.findings: list = []
+
+    def _emit(self, rule: str, node: ast.AST, message: str):
+        line = getattr(node, "lineno", 0)
+        suppressed = self.noqa.get(line)
+        if suppressed is not None and (not suppressed or rule in suppressed):
+            return
+        self.findings.append(
+            Finding(rule, RULES[rule][0], self.path, line,
+                    getattr(node, "col_offset", 0), message)
+        )
+
+    def run(self) -> list:
+        thread_targets = self._thread_target_names()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                self._lint_class(node)
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and node.name in thread_targets:
+                self._lint_thread_body(node)
+        return self.findings
+
+    # ---------------------------------------------------------- discovery
+
+    def _thread_target_names(self) -> set:
+        """Function/method names passed as ``threading.Thread(target=...)``
+        anywhere in the module."""
+        names: set = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain or chain[-1] != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                attr = _self_attr(kw.value)
+                if attr is not None:
+                    names.add(attr)
+                elif isinstance(kw.value, ast.Name):
+                    names.add(kw.value.id)
+        return names
+
+    @staticmethod
+    def _lock_attrs_of(cls: ast.ClassDef) -> set:
+        """Instance attrs assigned a ``threading.<Lock factory>()``."""
+        locks: set = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            chain = _attr_chain(node.value.func)
+            if (
+                len(chain) == 2
+                and chain[0] == "threading"
+                and chain[1] in _LOCK_FACTORIES
+            ):
+                for target in node.targets:
+                    attr = _self_attr(target)
+                    if attr is not None:
+                        locks.add(attr)
+        return locks
+
+    # -------------------------------------------------------------- rules
+
+    def _lint_class(self, cls: ast.ClassDef):
+        lock_attrs = self._lock_attrs_of(cls)
+        if not lock_attrs:
+            return
+        methods = [
+            n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        scans = {}
+        for method in methods:
+            scan = _MethodScan(
+                lock_attrs, assume_held=method.name.endswith("_locked")
+            )
+            scan.visit(method)
+            scans[method.name] = scan
+            for node, desc in scan.blocking:
+                self._emit(
+                    "AF2L010", node,
+                    f"blocking call {desc}() in {cls.name}.{method.name} "
+                    "while holding a lock: every thread contending for it "
+                    "stalls behind the I/O; move it outside the critical "
+                    "section",
+                )
+        guarded = {
+            attr
+            for scan in scans.values()
+            for attr, _, held in scan.mutations
+            if held
+        } - lock_attrs
+        for name, scan in scans.items():
+            if name == "__init__":
+                continue  # construction happens-before any other thread
+            for attr, node, held in scan.mutations:
+                if held or attr not in guarded:
+                    continue
+                self._emit(
+                    "AF2L011", node,
+                    f"self.{attr} is mutated under {cls.name}'s lock "
+                    f"elsewhere but written in {name}() without it: either "
+                    "take the lock here or document why this write cannot "
+                    "race",
+                )
+
+    def _lint_thread_body(self, fn):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            desc = None
+            if chain and chain[-1] in _HOST_SYNC_CALLS:
+                desc = ".".join(chain)
+            elif chain and len(chain) > 1 and chain[-1] in (
+                "item", "tolist"
+            ):
+                desc = f".{chain[-1]}()"
+            elif (
+                len(chain) >= 2
+                and chain[0] in _NUMPY_ALIASES
+                and chain[1] in ("asarray", "array")
+            ):
+                desc = ".".join(chain)
+            if desc:
+                self._emit(
+                    "AF2L012", node,
+                    f"host sync {desc} inside thread body {fn.name}(): "
+                    "this thread exists to keep the device pipeline full — "
+                    "a sync here serializes it; hand results back instead",
+                )
+
+
 # ------------------------------------------------------------------ drivers
 
 
 def lint_source(source: str, path: str = "<string>") -> list:
     """Lint one source string; returns a list of :class:`Finding`."""
-    return _Linter(path, source).run()
+    linter = _Linter(path, source)
+    findings = linter.run()
+    findings += _ThreadSafetyLinter(path, linter.tree, linter.noqa).run()
+    return sorted(findings, key=lambda f: (f.line, f.col, f.rule))
 
 
 def lint_file(path: str) -> list:

@@ -24,13 +24,25 @@ import numpy as np
 @dataclasses.dataclass(frozen=True)
 class TraceTarget:
     """One audited executable: ``build()`` returns ``(fn, args)`` ready for
-    ``jax.make_jaxpr(fn)(*args)``."""
+    ``jax.make_jaxpr(fn)(*args)``.
+
+    ``hlo=True`` additionally opts the target into the compiled-HLO audit
+    (:mod:`hlo_audit`): collective census, resharding detection and the
+    memory-budget contract. ``sharded`` declares the *intent* — a target
+    declared single-device must compile with zero cross-device collectives
+    (AF2A109), a sharded one must actually shard (AF2A108).
+    ``hbm_budget_bytes`` is the declared per-device footprint ceiling
+    (arguments + outputs + temporaries) the budget contract gates against
+    (AF2A110); None skips the gate with a loud "no-data" verdict."""
 
     name: str
     build: Callable[[], tuple]
     donate_argnums: tuple = ()
     allow: frozenset = frozenset()
     allow_reasons: Optional[dict] = None
+    hlo: bool = False
+    sharded: bool = False
+    hbm_budget_bytes: Optional[int] = None
 
     def __post_init__(self):
         missing = set(self.allow) - set(self.allow_reasons or {})
@@ -220,6 +232,49 @@ def _build_serve_fwd_grid():
     return fwd, (params, seq, msa, mask, msa_mask)
 
 
+def _build_serve_fwd_long():
+    """The crop-free long-chain rung's graph: the serve engine's _fwd on
+    the mesh-gated long-bucket ladder (ServeConfig.long_buckets), scaled
+    down to bucket 16 / batch 1 on a 1D (dp=1, sp=all) sequence-parallel
+    mesh. Unlike serve_fwd_grid (whose shard_map in_specs pin the layout
+    mechanically), this path's sharding rests ENTIRELY on the shard_pair
+    constraints at layer boundaries — it is the target where dropping one
+    constraint silently replicates the N^2 pair state onto every device,
+    which is exactly the cliff the HLO audit's resharding detector and
+    memory budget exist to catch before a bench ever runs."""
+    import jax
+    import jax.numpy as jnp
+
+    from alphafold2_tpu.parallel.sharding import make_mesh, use_mesh
+    from alphafold2_tpu.train.end2end import End2EndModel
+
+    bucket, batch, depth = 16, 1, 2
+    devices = jax.devices()
+    n_seq = min(8, len(devices))
+    mesh = make_mesh(1, n_seq, devices=devices[:n_seq])
+    model = End2EndModel(
+        dim=32, depth=1, heads=2, dim_head=16, max_seq_len=3 * bucket,
+        mds_iters=8, mds_per_position_init=True, dtype=jnp.float32,
+    )
+    seq = jnp.zeros((batch, bucket), jnp.int32)
+    msa = jnp.zeros((batch, depth, bucket), jnp.int32)
+    mask = jnp.ones((batch, bucket), bool)
+    msa_mask = jnp.ones((batch, depth, bucket), bool)
+    params = model.init(jax.random.key(0), seq, msa, mask=mask,
+                        msa_mask=msa_mask)
+    mds_key = jax.random.key(0)
+
+    def fwd(params, seq, msa, mask, msa_mask):
+        with use_mesh(mesh):
+            out = model.apply(
+                params, seq, msa, mask=mask, msa_mask=msa_mask,
+                mds_key=mds_key, deterministic=True,
+            )
+        return {"refined": out["refined"], "weights": out["weights"]}
+
+    return fwd, (params, seq, msa, mask, msa_mask)
+
+
 def _build_serve_fwd_bf16():
     """The serve engine's _fwd in the bf16 serving mode (serve.dtype=
     "bfloat16"): bf16-cast params + bf16 compute dtype, exactly what
@@ -308,10 +363,13 @@ def _build_attn_axial_pallas():
 
 def default_targets() -> list:
     """The audited surface: model forward, train step, serve forward
-    (single-device, grid-mesh-sharded, and bf16), and the fused Pallas
-    kernel graphs."""
+    (single-device, grid-mesh-sharded, long-bucket sequence-parallel, and
+    bf16), and the fused Pallas kernel graphs."""
     return [
-        TraceTarget(name="model_fwd", build=_build_model_fwd),
+        TraceTarget(
+            name="model_fwd", build=_build_model_fwd,
+            hlo=True, sharded=False, hbm_budget_bytes=64 << 20,
+        ),
         TraceTarget(
             name="train_step",
             build=_build_train_step,
@@ -334,6 +392,7 @@ def default_targets() -> list:
             # the engine donates the int/bool feature buffers
             # (donate_argnums=(1, 2, 3, 4) when serve.donate_buffers)
             donate_argnums=(1, 2, 3, 4),
+            hlo=True, sharded=False, hbm_budget_bytes=64 << 20,
             allow=frozenset({"AF2A104"}),
             allow_reasons={
                 "AF2A104": (
@@ -348,6 +407,25 @@ def default_targets() -> list:
             name="serve_fwd_grid",
             build=_build_serve_fwd_grid,
             donate_argnums=(1, 2, 3, 4),
+            hlo=True, sharded=True, hbm_budget_bytes=16 << 20,
+            allow=frozenset({"AF2A104"}),
+            allow_reasons={
+                "AF2A104": (
+                    "same early-free donation intent as serve_fwd: the "
+                    "sharded engine donates the int/bool feature buffers "
+                    "it device_put with explicit shardings"
+                ),
+            },
+        ),
+        TraceTarget(
+            name="serve_fwd_long",
+            build=_build_serve_fwd_long,
+            donate_argnums=(1, 2, 3, 4),
+            # the long-rung budget is deliberately tight (~5x the sharded
+            # per-device footprint): replicating the pair state by dropping
+            # a shard_pair constraint must blow THROUGH it, so the memory
+            # contract fails alongside the census drift
+            hlo=True, sharded=True, hbm_budget_bytes=8 << 20,
             allow=frozenset({"AF2A104"}),
             allow_reasons={
                 "AF2A104": (
@@ -361,6 +439,7 @@ def default_targets() -> list:
             name="serve_fwd_bf16",
             build=_build_serve_fwd_bf16,
             donate_argnums=(1, 2, 3, 4),
+            hlo=True, sharded=False, hbm_budget_bytes=64 << 20,
             allow=frozenset({"AF2A104", "AF2A105"}),
             allow_reasons={
                 "AF2A104": (
@@ -386,6 +465,17 @@ def default_targets() -> list:
             build=_build_attn_axial_pallas,
         ),
     ]
+
+
+def hlo_targets(targets=None) -> list:
+    """The compiled-HLO-audited subset: every target opted in with
+    ``hlo=True``. Train and Pallas-kernel targets stay out — the train
+    step's optax internals and the interpret-mode pallas_call callbacks
+    make their optimized HLO backend-dependent, while the serve/model
+    forwards are the executables the compile-once lattice actually
+    ships."""
+    targets = targets if targets is not None else default_targets()
+    return [t for t in targets if t.hlo]
 
 
 def target_by_name(name: str, targets=None) -> TraceTarget:

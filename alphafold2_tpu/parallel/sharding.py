@@ -23,6 +23,7 @@ single-chip, under tests, and on a pod.
 
 from __future__ import annotations
 
+import os
 from contextlib import contextmanager
 from typing import Optional
 
@@ -148,6 +149,13 @@ def batch_spec() -> P:
 
 def shard_pair(x):
     """Constrain a (B, N, N, D) or (B, N, N) pair array: batch x row sharded."""
+    if os.environ.get("AF2TPU_AUDIT_DROP_SHARD_PAIR"):
+        # Seeded-defect hook for the HLO audit's negative control (analysis/
+        # hlo_audit.py, CI static-analysis job): deliberately drop the pair
+        # constraint so the resharding detector must catch the resulting
+        # implicit all-gathers / per-device footprint blowup statically.
+        # Never set in production; trace-time only, so no runtime cost.
+        return x
     return _constrain(x, pair_spec())
 
 

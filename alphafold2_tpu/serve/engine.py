@@ -467,6 +467,20 @@ class ServeEngine:
             total_flops=costs["flops"],
         )
         self._exe_breakdown[key] = breakdown
+        collectives: dict = {}
+        if self.mesh is not None:
+            # census of the post-SPMD collectives XLA actually emitted for
+            # this rung (analysis/hlo_audit.py) — the runtime counterpart of
+            # the committed hlo_contracts.json; a rung whose census is empty
+            # here is paying for a mesh it does not use
+            try:
+                from alphafold2_tpu.analysis.hlo_audit import (
+                    collective_census,
+                )
+
+                collectives = collective_census(compiled.as_text())
+            except Exception:  # census is diagnostics, never a serve fault
+                collectives = {}
         self.compile_records.append({
             "bucket": bucket, "batch": batch,
             "seconds": round(time.perf_counter() - t0, 4),
@@ -481,6 +495,7 @@ class ServeEngine:
             **({"flops_breakdown": breakdown} if costs["flops"] else {}),
             **({"bytes_accessed": costs["bytes_accessed"]}
                if costs["bytes_accessed"] else {}),
+            **({"collectives": collectives} if collectives else {}),
             **memory,
         })
         self._executables[key] = compiled

@@ -549,10 +549,14 @@ class AsyncServeFrontend:
         tctx = pending.req.trace
         if tctx is not None and result.trace_id != tctx.trace_id:
             result = dataclasses.replace(result, trace_id=tctx.trace_id)
+        # Promote into the cache (and drain followers) BEFORE resolving the
+        # leader's handle: once .result() returns, a resubmit of the same key
+        # must observe a cache hit, not a still-in-flight entry.
+        followers = self.cache.fulfill(pending.key, result, cache=cache_ok)
         pending.handle._resolve(result)
         self._trace_resolve(tctx, result)
         self._notify(result, pending.priority)
-        for ctx in self.cache.fulfill(pending.key, result, cache=cache_ok):
+        for ctx in followers:
             handle, submit_ts = ctx[0], ctx[1]
             f_trace = ctx[2] if len(ctx) > 2 else None
             f_priority = ctx[3] if len(ctx) > 3 else 0

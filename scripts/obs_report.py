@@ -48,13 +48,24 @@ def _fmt_s(seconds: float) -> str:
 
 
 def classify(path: str) -> str:
-    """"trace" (Chrome trace events) vs "metrics" (MetricsLogger JSONL):
-    trace files open with ``[`` or hold events with a ``ph`` key; metrics
-    lines are flat records with a ``step`` key."""
+    """"trace" (Chrome trace events) vs "metrics" (MetricsLogger JSONL) vs
+    "hlo-contracts" (analysis/hlo_audit.py snapshot): trace files open with
+    ``[`` or hold events with a ``ph`` key; metrics lines are flat records
+    with a ``step`` key; an hlo_contracts.json is a single pretty-printed
+    object with ``format`` + ``targets``."""
     with open(path) as f:
         head = f.read(4096).lstrip()
     if head.startswith("["):
         return "trace"
+    if head.startswith("{"):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except json.JSONDecodeError:
+            doc = None
+        if (isinstance(doc, dict) and "format" in doc
+                and isinstance(doc.get("targets"), dict)):
+            return "hlo-contracts"
     first = head.splitlines()[0] if head else "{}"
     try:
         rec = json.loads(first)
@@ -333,6 +344,12 @@ def report_mesh(latest: dict) -> None:
             extra = ""
             if c.get("program_bytes"):
                 extra = f"  {c['program_bytes'] / 2**20:.1f} MiB/device"
+            census = c.get("collectives") or {}
+            if census:
+                n = sum(v["count"] for v in census.values())
+                moved = sum(v["bytes"] for v in census.values())
+                extra += (f"  {n} collectives "
+                          f"({moved / 2**10:.0f} KiB moved)")
             print(
                 f"    bucket {c['bucket']:>5} batch {c['batch']} "
                 f"mesh={c.get('mesh') or '-'}: compile "
@@ -367,6 +384,51 @@ def report_slo(latest: dict) -> None:
         if g("alert"):
             line += "  ** ALERT **"
         print(line)
+
+
+def report_hlo_contracts(path: str) -> list:
+    """Static comm/memory contract section for a committed (or freshly
+    ``--update``-written) hlo_contracts.json: per target the post-SPMD
+    collective census, comm bytes beside FLOPs, the XLA program footprint
+    and the HBM-budget verdict — the numbers ``analysis/hlo_audit.py
+    --check`` diffs in CI, rendered for humans. Always returns [] (a
+    malformed file raises into main()'s existing error path)."""
+    with open(path) as f:
+        doc = json.load(f)
+    targets = doc.get("targets") or {}
+    print(f"== hlo contracts {path}: {len(targets)} targets "
+          f"(format {doc.get('format')}, jax {doc.get('jax_version')}, "
+          f"{doc.get('n_devices')}x {doc.get('platform')}) ==")
+    for name in sorted(targets):
+        rec = targets[name]
+        parts = rec.get("num_partitions", 1)
+        head = f"  {name}: " + (
+            f"{parts}-way partitioned" if rec.get("sharded")
+            else "single-device"
+        )
+        if rec.get("program_bytes"):
+            head += f", program {rec['program_bytes'] / 2**20:.2f} MiB/device"
+        budget = rec.get("budget") or {}
+        if budget.get("verdict"):
+            head += f", budget {budget['verdict']}"
+            if budget.get("headroom_frac") is not None:
+                head += f" ({budget['headroom_frac']:+.1%} headroom)"
+        print(head)
+        census = rec.get("collectives") or {}
+        if census:
+            for kind in sorted(census):
+                c = census[kind]
+                print(f"    {kind:<20} x{c['count']:<4} "
+                      f"{c['bytes'] / 2**10:>10.1f} KiB")
+            ratio = rec.get("comm_bytes_per_flop")
+            line = (f"    comm total: {rec.get('comm_bytes', 0) / 2**10:.1f} "
+                    f"KiB moved")
+            if ratio is not None:
+                line += f"  ({ratio:.4g} bytes/FLOP)"
+            print(line)
+        elif rec.get("sharded"):
+            print("    (no collectives — sharding constraints are inert)")
+    return []
 
 
 def report_metrics(path: str) -> list:
@@ -451,7 +513,11 @@ def main(argv=None) -> int:
     for path in paths:
         try:
             kind = classify(path)
-            errs = (report_trace if kind == "trace" else report_metrics)(path)
+            reporter = {
+                "trace": report_trace,
+                "hlo-contracts": report_hlo_contracts,
+            }.get(kind, report_metrics)
+            errs = reporter(path)
             if errs:
                 parse_errors[path] = errs
         except (OSError, json.JSONDecodeError) as e:

@@ -204,6 +204,7 @@ def test_sparse_axial_in_grid_matches_meshless():
     )
 
 
+@pytest.mark.slow
 def test_sparse_grid_768_crop_step():
     """The 768-crop story (grid_parallel.py module docstring): one sparse
     axial pass over a (1, 768, 768) grid on the 8-virtual-device mesh.

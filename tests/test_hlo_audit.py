@@ -1,0 +1,305 @@
+"""HLO-audit tests: collective-census parsing over synthetic HLO text,
+the baseline-free structural rules (AF2A108-110), budget verdicts, exact
+contract diffing with named per-collective deltas, and the baseline gate's
+verdict machinery — all compile-free. The committed-baseline check and the
+seeded-defect negative control (drop one shard_pair constraint, watch the
+named all-gather delta fail the gate with no bench run) live in the slow
+tier, mirroring CI's static-analysis job."""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from alphafold2_tpu.analysis import budgets, hlo_audit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# A hand-written optimized-HLO module exercising every parser edge: the
+# num_partitions header attribute, an operand *reference* to an op named
+# %all-gather.3 (must not count), an async -start/-done pair (must count
+# once), and a tuple-shaped all-to-all (bytes summed over elements).
+SYN_HLO = """\
+HloModule jit_f, is_scheduled=true, num_partitions=8, \
+entry_computation_layout={(f32[8,16]{1,0})->f32[64,16]{1,0}}
+
+ENTRY %main (p0: f32[8,16]) -> f32[64,16] {
+  %p0 = f32[8,16]{1,0} parameter(0)
+  %all-gather.3 = f32[64,16]{1,0} all-gather(f32[8,16]{1,0} %p0), dimensions={0}
+  %add = f32[64,16]{1,0} add(f32[64,16]{1,0} %all-gather.3, f32[64,16]{1,0} %all-gather.3)
+  %ars = f32[64,16]{1,0} all-reduce-start(f32[64,16]{1,0} %add), to_apply=%sum
+  %ard = f32[64,16]{1,0} all-reduce-done(f32[64,16]{1,0} %ars)
+  %ata = (bf16[8,16]{1,0}, bf16[8,16]{1,0}) all-to-all(bf16[8,16]{1,0} %p0, bf16[8,16]{1,0} %p0)
+  ROOT %out = f32[64,16]{1,0} copy(f32[64,16]{1,0} %ard)
+}
+"""
+
+
+# --------------------------------------------------------------- parsing
+
+
+def test_shape_bytes():
+    assert hlo_audit.shape_bytes("f32[128,256]") == 128 * 256 * 4
+    assert hlo_audit.shape_bytes("bf16[8]") == 16
+    assert hlo_audit.shape_bytes("pred[4]") == 4
+    assert hlo_audit.shape_bytes("f32[]") == 4  # scalar
+    assert hlo_audit.shape_bytes("not-a-shape") == 0
+
+
+def test_parse_collectives_on_synthetic_module():
+    ops = hlo_audit.parse_collectives(SYN_HLO)
+    assert [(o["kind"], o["bytes"]) for o in ops] == [
+        ("all-gather", 64 * 16 * 4),   # gathered result shape
+        ("all-reduce", 64 * 16 * 4),   # the -start half, counted once
+        ("all-to-all", 2 * 8 * 16 * 2),  # tuple of two bf16[8,16]
+    ]
+
+
+def test_census_aggregates_and_sorts():
+    census = hlo_audit.collective_census(SYN_HLO + SYN_HLO)
+    assert list(census) == sorted(census)
+    assert census["all-gather"] == {"count": 2, "bytes": 2 * 4096}
+    assert census["all-to-all"]["count"] == 2
+
+
+def test_operand_references_and_done_halves_not_counted():
+    # only the three real collectives: the %all-gather.3 operand refs on
+    # the add line and the all-reduce-done line contribute nothing
+    assert sum(
+        v["count"] for v in hlo_audit.collective_census(SYN_HLO).values()
+    ) == 3
+
+
+def test_num_partitions_header():
+    assert hlo_audit.num_partitions(SYN_HLO) == 8
+    assert hlo_audit.num_partitions("HloModule jit_f\n\nENTRY %main") == 1
+    # the attribute can sit many KB into the header line — whole-text scan
+    padded = "HloModule jit_f, layout={" + "x" * 5000 + "}, num_partitions=4"
+    assert hlo_audit.num_partitions(padded) == 4
+
+
+# ------------------------------------------------------ structural rules
+
+
+def record(**kw):
+    base = {
+        "sharded": False, "num_partitions": 1, "collectives": {},
+        "collective_count": 0, "comm_bytes": 0, "flops": 1e6,
+        "program_bytes": 100, "hbm_budget_bytes": None,
+        "budget": {"verdict": "no-data"},
+    }
+    base.update(kw)
+    return base
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def test_collectives_in_single_device_target_flagged():
+    rec = record(
+        collectives={"all-gather": {"count": 2, "bytes": 64}},
+        collective_count=2,
+    )
+    findings = hlo_audit.audit_record("t", rec)
+    assert rules_of(findings) == ["AF2A109"]
+    assert "all-gather x2" in findings[0].message
+
+
+def test_sharded_target_with_zero_collectives_flagged():
+    rec = record(sharded=True, num_partitions=4)
+    findings = hlo_audit.audit_record("t", rec)
+    assert rules_of(findings) == ["AF2A108"]
+    assert "inert" in findings[0].message
+
+
+def test_single_collective_blowup_flagged():
+    rec = record(
+        sharded=True, num_partitions=4, hbm_budget_bytes=1024,
+        collectives={"all-gather": {"count": 1, "bytes": 4096}},
+        collective_count=1,
+    )
+    findings = hlo_audit.audit_record(
+        "t", rec, per_op=[{"kind": "all-gather", "bytes": 4096}]
+    )
+    assert rules_of(findings) == ["AF2A108"]
+    assert "blowup" in findings[0].message
+
+
+def test_over_budget_footprint_flagged():
+    verdict = budgets.check_budget(2048, 1024)
+    rec = record(
+        sharded=True, num_partitions=4, hbm_budget_bytes=1024,
+        collectives={"all-reduce": {"count": 1, "bytes": 8}},
+        collective_count=1, program_bytes=2048, budget=verdict,
+    )
+    findings = hlo_audit.audit_record("t", rec)
+    assert rules_of(findings) == ["AF2A110"]
+    assert "2048" in findings[0].message
+
+
+def test_healthy_sharded_record_is_clean():
+    rec = record(
+        sharded=True, num_partitions=4, hbm_budget_bytes=1 << 20,
+        collectives={"all-reduce": {"count": 3, "bytes": 96}},
+        collective_count=3, budget=budgets.check_budget(100, 1 << 20),
+    )
+    assert hlo_audit.audit_record(
+        "t", rec, per_op=[{"kind": "all-reduce", "bytes": 32}] * 3
+    ) == []
+
+
+# --------------------------------------------------------------- budgets
+
+
+def test_budget_verdicts():
+    ok = budgets.check_budget(100, 1000)
+    assert ok["verdict"] == "pass" and ok["headroom_frac"] == 0.9
+    over = budgets.check_budget(2000, 1000)
+    assert over["verdict"] == "over-budget"
+    assert over["headroom_frac"] == -1.0
+    assert budgets.check_budget(None, 1000)["verdict"] == "no-data"
+    assert budgets.check_budget(100, None)["verdict"] == "no-data"
+
+
+def test_format_budget_lines():
+    assert "pass" in budgets.format_budget("t", budgets.check_budget(1, 2))
+    assert "no-data" in budgets.format_budget(
+        "t", budgets.check_budget(1, None)
+    )
+
+
+def test_device_hbm_env_override(monkeypatch):
+    monkeypatch.setenv("AF2TPU_HBM_BYTES", str(16 << 30))
+    assert budgets.device_hbm_bytes() == 16 << 30
+    monkeypatch.delenv("AF2TPU_HBM_BYTES")
+    # CPU test devices have no published HBM figure: explicit None
+    assert budgets.device_hbm_bytes() is None
+
+
+# ------------------------------------------------------------- diff/gate
+
+
+def base_doc():
+    return {
+        "format": hlo_audit.FORMAT_VERSION, "jax_version": "0.0.test",
+        "n_devices": 8, "platform": "cpu",
+        "targets": {
+            "t": {
+                "sharded": True, "num_partitions": 8,
+                "collectives": {
+                    "all-gather": {"count": 20, "bytes": 890_000},
+                    "all-reduce": {"count": 7, "bytes": 280},
+                },
+                "collective_count": 27, "comm_bytes": 890_280,
+                "flops": 1000.0, "argument_bytes": 10, "output_bytes": 5,
+                "temp_bytes": 1, "program_bytes": 1_000_000,
+                "hbm_budget_bytes": 8 << 20,
+                "budget": {"verdict": "pass"},
+            }
+        },
+    }
+
+
+def test_diff_names_the_dropped_collective_and_the_blowup():
+    base, cur = base_doc(), base_doc()
+    rec = cur["targets"]["t"]
+    del rec["collectives"]["all-gather"]  # the dropped-shard_pair shape
+    rec["comm_bytes"] = 280
+    rec["program_bytes"] = 5_520_000
+    rec["budget"] = {"verdict": "over-budget"}
+    lines = hlo_audit.diff_hlo_contracts(base, cur)
+    joined = "\n".join(lines)
+    assert "t: all-gather count drift: 20 -> 0 (-20)" in lines
+    assert "t: all-gather bytes drift: 890000 -> 0 (-890000)" in lines
+    assert "program_bytes drift: 1000000 -> 5520000 (5.52x)" in joined
+    assert "budget verdict drift: pass -> over-budget" in joined
+    # the unchanged all-reduce census produces no line
+    assert "all-reduce" not in joined
+
+
+def test_diff_new_and_missing_targets_and_subset():
+    base, cur = base_doc(), base_doc()
+    cur["targets"]["extra"] = cur["targets"]["t"]
+    assert any(
+        "extra: NEW TARGET" in ln
+        for ln in hlo_audit.diff_hlo_contracts(base, cur)
+    )
+    only_new = {**base_doc(), "targets": {"extra": base_doc()["targets"]["t"]}}
+    full = hlo_audit.diff_hlo_contracts(base, only_new)
+    assert any("t: missing from current audit" in ln for ln in full)
+    # a --targets subset run must not read unaudited targets as removed
+    sub = hlo_audit.diff_hlo_contracts(base, only_new, subset=True)
+    assert not any("missing" in ln for ln in sub)
+
+
+def test_check_against_verdicts(tmp_path):
+    path = tmp_path / "hlo_contracts.json"
+    assert hlo_audit.check_against(
+        str(path), base_doc()
+    )["verdict"] == "missing-baseline"
+
+    path.write_text(json.dumps(base_doc()))
+    assert hlo_audit.check_against(str(path), base_doc()) == {
+        "verdict": "pass", "drift": [],
+    }
+
+    stale = base_doc()
+    stale["jax_version"] = "9.9.9"
+    res = hlo_audit.check_against(str(path), stale)
+    assert res["verdict"] == "stale-baseline"
+    assert "RECOMPILE KEY jax_version" in res["reason"]
+
+    drifted = copy.deepcopy(base_doc())
+    drifted["targets"]["t"]["collectives"]["all-gather"]["count"] = 21
+    res = hlo_audit.check_against(str(path), drifted)
+    assert res["verdict"] == "drift"
+    assert any("all-gather count drift" in ln for ln in res["drift"])
+
+
+def test_cli_unknown_target_is_usage_error(capsys):
+    assert hlo_audit.main(["--check", "--targets", "no_such"]) == 2
+    assert "unknown hlo target" in capsys.readouterr().err
+
+
+# ------------------------------------------------- real targets (slow tier)
+
+
+@pytest.mark.slow
+def test_committed_hlo_baseline_holds():
+    """The shipped targets compile with zero structural findings and match
+    the committed hlo_contracts.json — the CI static-analysis job's
+    in-suite twin (stale-baseline accepted, exactly like the CLI, when the
+    environment's recompile keys differ)."""
+    doc, findings = hlo_audit.audit_hlo()
+    assert findings == [], [f.format() for f in findings]
+    result = hlo_audit.check_against(hlo_audit.DEFAULT_BASELINE, doc)
+    assert result["verdict"] in ("pass", "stale-baseline"), result
+
+
+@pytest.mark.slow
+def test_seeded_defect_fails_statically():
+    """The acceptance criterion: dropping a single shard_pair constraint
+    (AF2TPU_AUDIT_DROP_SHARD_PAIR, parallel/sharding.py) must fail the
+    gate with a *named* all-gather census delta — caught at compile time,
+    no bench run."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "AF2TPU_AUDIT_DROP_SHARD_PAIR": "1"}
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    proc = subprocess.run(
+        [sys.executable, "-m", "alphafold2_tpu.analysis.hlo_audit",
+         "--check", "--targets", "serve_fwd_long"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "all-gather count drift" in proc.stdout
+    assert "AF2A107" in proc.stdout  # contract drift
+    assert "AF2A108" in proc.stdout  # replicated: zero collectives
+    assert "AF2A110" in proc.stdout  # replication blew the HBM budget

@@ -140,6 +140,76 @@ def test_waiver_without_reason_is_rejected():
         )
 
 
+# ------------------------------------------------ deep sub-jaxpr recursion
+
+
+def test_callback_buried_in_custom_vjp_bwd():
+    """The violation hides in the custom_vjp *backward* body — reachable
+    only through the fwd/bwd thunks iter_eqns_deep unpacks, never through
+    the plain forward trace."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x * 2.0
+
+    def f_fwd(x):
+        return f(x), x
+
+    def f_bwd(res, g):
+        jax.debug.callback(lambda v: None, res)
+        return (g * 2.0,)
+
+    f.defvjp(f_fwd, f_bwd)
+
+    t = synthetic("vjp", lambda x: f(x).sum(), (jnp.ones((4,)),))
+    assert "AF2A102" in rules_of(jaxpr_audit.audit_target(t))
+
+
+def test_callback_buried_in_custom_jvp_rule():
+    @jax.custom_jvp
+    def g(x):
+        return x * 2.0
+
+    @g.defjvp
+    def g_jvp(primals, tangents):
+        (x,), (t,) = primals, tangents
+        jax.debug.callback(lambda v: None, x)
+        return g(x), t * 2.0
+
+    t = synthetic("jvp", lambda x: g(x).sum(), (jnp.ones((4,)),))
+    assert "AF2A102" in rules_of(jaxpr_audit.audit_target(t))
+
+
+def test_callback_buried_in_nested_jit():
+    inner = jax.jit(
+        lambda x: jax.pure_callback(
+            lambda v: np.sin(v), jax.ShapeDtypeStruct((4,), jnp.float32), x
+        )
+    )
+    t = synthetic("pjit", lambda x: inner(x) + 1.0, (jnp.ones((4,)),))
+    assert "AF2A102" in rules_of(jaxpr_audit.audit_target(t))
+
+
+def test_clean_custom_vjp_recursion_terminates():
+    """The standard fwd-calls-f pattern re-embeds the custom_vjp_call in
+    its own forward body; the signature seen-guard must terminate the walk
+    and report nothing."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x * 2.0
+
+    def f_fwd(x):
+        return f(x), x
+
+    def f_bwd(res, g):
+        return (g * 2.0,)
+
+    f.defvjp(f_fwd, f_bwd)
+    t = synthetic("vjp_ok", lambda x: f(x).sum(), (jnp.ones((4,)),))
+    assert jaxpr_audit.audit_target(t) == []
+
+
 # ---------------------------------------------------------- real targets
 
 

@@ -270,6 +270,128 @@ def test_blanket_noqa_suppresses_all_rules():
     assert rules_of(src) == []
 
 
+# ------------------------------------------- thread-safety (AF2L010-012)
+
+
+def test_blocking_call_under_lock_flagged():
+    src = """
+    import threading
+    import time
+
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def add(self, x):
+            with self._lock:
+                self._items.append(x)
+                time.sleep(0.1)
+    """
+    assert rules_of(src) == ["AF2L010"]
+    assert rules_of(src.replace(
+        "time.sleep(0.1)", "time.sleep(0.1)  # af2: noqa[AF2L010]"
+    )) == []
+
+
+def test_condition_wait_under_lock_is_not_blocking():
+    """Waiting on the lock's own condition RELEASES it — the one
+    blocking-looking call that is the correct pattern."""
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self._items = []
+
+        def get(self):
+            with self._cv:
+                while not self._items:
+                    self._cv.wait()
+                return self._items.pop()
+    """
+    assert rules_of(src) == []
+
+
+def test_guarded_state_mutated_outside_lock():
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def add(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def drop(self):
+            self._items.pop()
+    """
+    assert rules_of(src) == ["AF2L011"]
+    # __init__ assignments never fire (the snippet's self._items = [] is
+    # silent); the *_locked suffix documents "caller holds the lock"
+    assert rules_of(src.replace("def drop", "def drop_locked")) == []
+    assert rules_of(src.replace(
+        "self._items.pop()", "self._items.pop()  # af2: noqa[AF2L011]"
+    )) == []
+
+
+def test_locked_suffix_method_assumes_lock_held():
+    """The *_locked convention cuts both ways: its body is a critical
+    section, so blocking calls inside it fire AF2L010."""
+    src = """
+    import threading
+    import time
+
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def _flush_locked(self):
+            time.sleep(0.5)
+    """
+    assert rules_of(src) == ["AF2L010"]
+
+
+def test_host_sync_in_thread_body_flagged():
+    src = """
+    import threading
+    import jax
+
+    class W:
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            jax.device_get(self.buf)
+    """
+    assert rules_of(src) == ["AF2L012"]
+
+
+def test_host_sync_outside_thread_body_is_fine():
+    src = """
+    import jax
+
+    class W:
+        def fetch(self):
+            return jax.device_get(self.buf)
+    """
+    assert rules_of(src) == []
+
+
+def test_serve_layer_threadsafety_clean():
+    """The satellite's acceptance bar, pinned per file: the scheduler and
+    the engine — the two lock-heavy serve modules — carry zero findings."""
+    for rel in ("serve/scheduler.py", "serve/engine.py"):
+        path = os.path.join(REPO, "alphafold2_tpu", rel)
+        findings = lint.lint_file(path)
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+
 # ----------------------------------------------------------- repo + CLI
 
 

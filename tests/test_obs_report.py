@@ -135,3 +135,17 @@ def test_obs_report_reads_standalone_metrics(obs_report, tmp_path, capsys):
     out = capsys.readouterr().out
     assert "hit rate 75.0%" in out
     assert "HBM peak: 1.000 GiB" in out
+
+
+def test_obs_report_renders_hlo_contracts(obs_report, capsys):
+    """The committed hlo_contracts.json classifies as its own artifact
+    kind and renders the census + budget verdicts (the human view of the
+    static comm/memory contract the hlo_audit gate diffs)."""
+    path = os.path.join(REPO, "hlo_contracts.json")
+    assert obs_report.classify(path) == "hlo-contracts"
+    assert obs_report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "hlo contracts" in out
+    assert "serve_fwd_long" in out and "8-way partitioned" in out
+    assert "all-gather" in out and "bytes/FLOP" in out
+    assert "budget pass" in out

@@ -45,6 +45,7 @@ def test_forward_matches_plain_autodiff_path():
         np.testing.assert_allclose(a, b, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_reversible_grad_parity():
     """The custom (inversion-based) backward == plain autodiff, for both
     parameter and input gradients — the reference's own oracle standard
@@ -150,6 +151,7 @@ def test_no_masks_path():
     assert np.isfinite(np.asarray(xo)).all()
 
 
+@pytest.mark.slow
 def test_model_reversible_trains():
     """Alphafold2(reversible=True): forward + one grad step, finite, and the
     distogram head shape is unchanged."""

@@ -58,6 +58,7 @@ def test_dp_sp_step_matches_single_device():
         assert np.allclose(a, b, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_context_parallel_matches_dense_cross_attn():
     # model with context_parallel="ring": the trunk cross-attention runs via
     # shard_map ppermute ring; numbers must match the dense path exactly
@@ -96,6 +97,7 @@ def test_sp_only_mesh():
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_graft_dryrun_multichip():
     import __graft_entry__
 

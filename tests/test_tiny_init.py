@@ -9,6 +9,7 @@ this is what lets every driver skip the full-size init compile (measured
 
 import jax
 import numpy as np
+import pytest
 
 from alphafold2_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
 from alphafold2_tpu.data.pipeline import SyntheticDataset
@@ -63,6 +64,7 @@ def test_tiny_init_preserves_plm_feature_structure():
     assert tiny["embedds"].shape[-1] == batch["embedds"].shape[-1]
 
 
+@pytest.mark.slow
 def test_tiny_init_matches_full_init_end2end():
     # the end2end drivers init from tiny_batch_like too: the structure half
     # (MDS realization, sidechain lift, SE3 refiner) must also be free of
@@ -80,6 +82,7 @@ def test_tiny_init_matches_full_init_end2end():
     _assert_identical(full, tiny)
 
 
+@pytest.mark.slow
 def test_tiny_init_matches_full_init_templates():
     # bench_suite config_4 inits at tiny template shapes inline; this pins
     # the invariant that run relies on: the template embedder (with and

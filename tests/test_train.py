@@ -89,6 +89,7 @@ def test_train_step_transfer_guard_clean(
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_train_grad_strict_promotion(tiny_step_setup, strict_promotion):
     """Forward + distogram loss + backward trace cleanly under strict
     dtype promotion — the first-party surface of the train step (the optax
@@ -172,6 +173,7 @@ def test_config_overrides_and_roundtrip():
     assert cfg3.model.depth == 12
 
 
+@pytest.mark.slow
 def test_ingraph_multistep_matches_sequential():
     """bench.py's lax.scan-chained stepping == the same steps dispatched
     one jit call at a time (same rng schedule, same params)."""
