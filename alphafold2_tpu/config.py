@@ -125,6 +125,17 @@ class ServeConfig:
     kernels: str = ""
     donate_buffers: bool = True  # donate per-request feature buffers to XLA
     return_distogram: bool = False  # ship (3L,3L,K) logits back per request
+    # --- pipelined dispatch (serve/pipeline.py: PipelinedDispatcher) ---
+    # batches in flight at once: the host stage featurizes + device_puts
+    # batch N+1 while batch N computes and batch N-1's results fetch, so
+    # the executable stays fed. 2 = classic double buffering; 0 disables
+    # the pipeline (every dispatch runs the serial featurize->compute->
+    # fetch path in the calling thread, pre-pipeline behavior)
+    pipeline_depth: int = 2
+    # admit a request arriving while its bucket's next formation is still
+    # in the host stage into that in-flight batch (continuous batching)
+    # instead of making it wait a full fill-or-dwell window
+    inflight_admission: bool = True
     # --- async frontend (serve/scheduler.py: AsyncServeFrontend) ---
     queue_depth: int = 64  # bounded admission queue; full -> structured reject
     dwell_ms: float = 25.0  # max wait for batch fill before partial dispatch

@@ -40,6 +40,15 @@ DEFAULT_THRESHOLDS = {
     "p50_ms": ("lower", 0.50),
     "p95_ms": ("lower", 0.50),
     "p99_ms": ("lower", 0.50),
+    # absolute gate (baseline-independent), serve records only (train
+    # records don't carry the key): fraction of the dispatch window the
+    # device sat idle, computed from the trace spans
+    # (observe.tracing.device_idle_fraction). The pipelined dispatch
+    # exists to keep this low on the closed-loop bench — host featurize /
+    # transfer / unpad overlapping compute; a pipeline wired wrong (a
+    # stage serializing again, a lost overlap) shows up here before it
+    # shows up in throughput noise.
+    "device_idle_frac": ("absmax", 0.30),
 }
 
 # serve-async (open-loop frontend) records: the headline is goodput and
@@ -71,6 +80,12 @@ SERVE_ASYNC_THRESHOLDS = {
     # ≥99% of non-rejected requests must reconstruct a complete trace.
     "telemetry_overhead_frac": ("absmax", 0.05),
     "trace_complete_fraction": ("absmin", 0.99),
+    # open-loop device idleness is dominated by the offered arrival rate
+    # (the device legitimately waits for Poisson gaps and dwell windows),
+    # so the absolute bound is necessarily loose — it exists to catch the
+    # pipeline collapsing entirely (idle ~1.0 under saturating load), not
+    # to assert continuous occupancy
+    "device_idle_frac": ("absmax", 0.90),
 }
 
 # mesh-sharded serve records (a "mesh" key beside mode=serve): throughput
@@ -86,6 +101,10 @@ SERVE_MESH_THRESHOLDS = {
     "p95_ms": ("lower", 2.50),
     "p99_ms": ("lower", 2.50),
     "per_device_program_bytes": ("lower", 1.00),
+    # looser than the single-device bound: the CPU mesh's per-dispatch
+    # host work (sharded device_puts per axis) is a larger fraction of
+    # its window, and the gate targets lost-overlap cliffs, not jitter
+    "device_idle_frac": ("absmax", 0.50),
 }
 
 # kernels microbench (bench.py --mode kernels): fused-vs-stock attention
@@ -148,12 +167,15 @@ def comparable_reason(current: dict, baseline: dict) -> Optional[str]:
     if cur_dev and base_dev and cur_dev != base_dev:
         return f"device mismatch: current={cur_dev!r} baseline={base_dev!r}"
     # variant keys records carry only when non-default: mesh identity
-    # (sharded serving), serving dtype (bf16 mode) and kernel policy
-    # (fused Pallas selection). A sharded vs single-device number, a bf16
-    # vs f32 one, or two different kernel selections are not comparisons —
-    # precision/kernel changes must surface as explicit no-data diffs (and
-    # their own baselines), never as silent ratio drift.
-    for key in ("mesh", "dtype", "kernels"):
+    # (sharded serving), serving dtype (bf16 mode), kernel policy
+    # (fused Pallas selection) and dispatch pipeline ("depth2"/"off" —
+    # pipelined and serial dispatch have different latency anatomy, so a
+    # pipelined record must never ratio against a pre-pipeline baseline).
+    # A sharded vs single-device number, a bf16 vs f32 one, or two
+    # different kernel selections are not comparisons — precision/kernel
+    # changes must surface as explicit no-data diffs (and their own
+    # baselines), never as silent ratio drift.
+    for key in ("mesh", "dtype", "kernels", "pipeline"):
         if current.get(key) != baseline.get(key):
             return (
                 f"{key} mismatch: current={current.get(key)!r} "

@@ -227,6 +227,60 @@ class Tracer:
             pass
 
 
+# span names whose duration means "the device is (or is being kept) busy":
+# serve.dispatch covers executable submission through (sync path) blocking
+# execution; serve.device_get blocks until execution drains and results
+# land on the host, so its extent covers the async execution tail too
+DEVICE_SPAN_NAMES = ("serve.dispatch", "serve.device_get")
+
+
+def merge_intervals(intervals) -> list:
+    """Union a list of (start, end) intervals into disjoint sorted spans."""
+    merged: list = []
+    for start, end in sorted((s, e) for s, e in intervals if e > s):
+        if merged and start <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], end))
+        else:
+            merged.append((start, end))
+    return merged
+
+
+def device_idle_fraction(events, names=DEVICE_SPAN_NAMES) -> Optional[dict]:
+    """Device idle fraction over a serve trace: 1 - (union of device-busy
+    span extents) / (window from first device span start to last end).
+
+    The pipeline's whole point is to shrink this number — host featurize /
+    device_put / unpad overlapping with compute shows up directly as busy
+    spans tiling the window. Computed from the same trace events the
+    Chrome timeline renders, so the metric and the picture can't diverge.
+    Returns ``{"device_idle_frac", "busy_s", "window_s", "dispatches"}``,
+    or None when the trace holds no ``serve.dispatch`` span (nothing was
+    dispatched — an idle fraction would be meaningless).
+    """
+    intervals = []
+    dispatches = 0
+    for e in events:
+        if e.get("ph") != "X" or e.get("name") not in names:
+            continue
+        ts = e.get("ts", 0.0)
+        intervals.append((ts / 1e6, (ts + e.get("dur", 0.0)) / 1e6))
+        if e.get("name") == "serve.dispatch":
+            dispatches += 1
+    if not dispatches or not intervals:
+        return None
+    lo = min(s for s, _ in intervals)
+    hi = max(e for _, e in intervals)
+    window = hi - lo
+    busy = sum(e - s for s, e in merge_intervals(intervals))
+    idle = max(0.0, 1.0 - busy / window) if window > 0 else 0.0
+    return {
+        "device_idle_frac": round(idle, 4),
+        "busy_s": round(busy, 6),
+        "window_s": round(window, 6),
+        "dispatches": dispatches,
+    }
+
+
 def load_trace_events(path: str) -> list:
     """Parse a trace file written by ``Tracer`` (or any Chrome trace-event
     JSON array). Tolerates the streaming form: leading ``[``, one event per

@@ -4,14 +4,17 @@ See :mod:`alphafold2_tpu.serve.engine` (the synchronous batched engine),
 :mod:`alphafold2_tpu.serve.bucketing` (the ladder math),
 :mod:`alphafold2_tpu.serve.scheduler` (the async open-loop frontend:
 admission control, deadlines, continuous batch formation),
-:mod:`alphafold2_tpu.serve.cache` (LRU result cache + in-flight dedup) and
-:mod:`alphafold2_tpu.serve.faults` (deterministic fault injection).
+:mod:`alphafold2_tpu.serve.cache` (LRU result cache + in-flight dedup),
+:mod:`alphafold2_tpu.serve.faults` (deterministic fault injection) and
+:mod:`alphafold2_tpu.serve.pipeline` (double-buffered host/device dispatch
+pipeline with in-flight batch admission).
 Configured by ``config.ServeConfig``; benched by ``bench.py --mode serve``
 (closed loop) and ``--mode serve-async`` (open loop, Poisson arrivals).
 """
 
 from alphafold2_tpu.serve.bucketing import (
     bucket_for,
+    formation_ripe,
     geometric_ladder,
     padding_fraction,
     validate_ladder,
@@ -19,18 +22,27 @@ from alphafold2_tpu.serve.bucketing import (
 from alphafold2_tpu.serve.cache import ResultCache, result_key
 from alphafold2_tpu.serve.engine import ServeEngine, ServeRequest, ServeResult
 from alphafold2_tpu.serve.faults import FaultPlan, InjectedFault
+from alphafold2_tpu.serve.pipeline import (
+    DispatchHandle,
+    PipelineBatch,
+    PipelinedDispatcher,
+)
 from alphafold2_tpu.serve.scheduler import AsyncServeFrontend, PendingResult
 
 __all__ = [
     "AsyncServeFrontend",
+    "DispatchHandle",
     "FaultPlan",
     "InjectedFault",
     "PendingResult",
+    "PipelineBatch",
+    "PipelinedDispatcher",
     "ResultCache",
     "ServeEngine",
     "ServeRequest",
     "ServeResult",
     "bucket_for",
+    "formation_ripe",
     "geometric_ladder",
     "padding_fraction",
     "result_key",

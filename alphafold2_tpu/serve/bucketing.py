@@ -61,6 +61,22 @@ def geometric_ladder(lo: int, hi: int, ratio: float = 1.5) -> tuple:
     return tuple(out)
 
 
+def formation_ripe(
+    n_queued: int, fill: int, oldest_wait_s: float, dwell_s: float
+) -> bool:
+    """Fill-or-dwell batch-formation predicate: a bucket's queue dispatches
+    when it reaches its fill target (a full batch) or its oldest member has
+    waited ``dwell_s`` (latency bound on partial batches).
+
+    This is the *queue-side* barrier only — with pipelined dispatch, a
+    request arriving while the bucket's previous formation is still in the
+    host stage joins that in-flight batch instead of queueing behind this
+    predicate (continuous batching; serve.inflight_admission)."""
+    if n_queued <= 0:
+        return False
+    return n_queued >= max(1, int(fill)) or oldest_wait_s >= dwell_s
+
+
 def padding_fraction(lengths: Sequence[int], buckets: Sequence[int]) -> float:
     """Fraction of padded (wasted) positions a request mix incurs on this
     ladder — an ops-facing planning metric (also in bench_serve records)."""

@@ -32,7 +32,9 @@ layer the ROADMAP north star needs instead:
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
+import warnings
 from contextlib import nullcontext
 from typing import Optional, Sequence, Union
 
@@ -297,9 +299,44 @@ class ServeEngine:
             )
         self._mds_key = jax.random.key(cfg.train.seed)
         self._executables: dict = {}
+        # the compile path and the flops accumulators are shared with the
+        # pipeline's worker threads: double-checked locking on the
+        # executable cache, a dedicated lock for executed-flops accounting
+        self._compile_lock = threading.Lock()
+        self._account_lock = threading.Lock()
         # params replicated onto the mesh once, reused by every sharded
         # dispatch (a sharded executable rejects differently-placed inputs)
         self._mesh_params = None
+        # pipelined dispatch (serve/pipeline.py): depth batches in flight,
+        # host featurize/device_put overlapping device compute overlapping
+        # result fetch. 0 disables it (pure serial dispatch).
+        self.pipeline_depth = int(cfg.serve.pipeline_depth)
+        if self.pipeline_depth < 0:
+            raise ValueError(
+                f"serve.pipeline_depth must be >= 0, got {self.pipeline_depth}"
+            )
+        self.pipeline = None
+        if self.pipeline_depth > 0:
+            from alphafold2_tpu.serve.pipeline import PipelinedDispatcher
+
+            self.pipeline = PipelinedDispatcher(
+                self, depth=self.pipeline_depth
+            )
+
+    @property
+    def pipeline_desc(self) -> str:
+        """The dispatch-path identity serve records carry (``"depth2"`` /
+        ``"off"``) — regress.py refuses to compare across it, the same way
+        mesh/dtype/kernels variants are fenced."""
+        return (
+            f"depth{self.pipeline_depth}" if self.pipeline is not None
+            else "off"
+        )
+
+    def close(self) -> None:
+        """Stop the pipeline stage workers (in-flight batches drain first)."""
+        if self.pipeline is not None:
+            self.pipeline.shutdown(wait=True)
 
     def _validate_mesh(self, mesh: Mesh, cfg: Config) -> None:
         from alphafold2_tpu.parallel.grid_parallel import (
@@ -416,6 +453,17 @@ class ServeEngine:
         if hit is not None:
             self.counters.bump("serve.cache_hits")
             return hit
+        with self._compile_lock:
+            return self._compile_executable(key, bucket, batch)
+
+    def _compile_executable(self, key, bucket: int, batch: int):
+        """Build + record one executable; caller holds ``_compile_lock``
+        (the pipeline's device worker, the sync path and warmup can race
+        to the same rung — exactly one of them compiles)."""
+        hit = self._executables.get(key)
+        if hit is not None:  # lost the race: the build already happened
+            self.counters.bump("serve.cache_hits")
+            return hit
         donate = (1, 2, 3, 4) if self.cfg.serve.donate_buffers else ()
         abstract = self._abstract_batch(bucket, batch)
         jit_kwargs: dict = {"donate_argnums": donate}
@@ -428,22 +476,21 @@ class ServeEngine:
             dp = NamedSharding(self.mesh, P(DATA_AXIS))
             jit_kwargs["in_shardings"] = (rep, dp, dp, dp, dp)
         ctx = use_mesh(self.mesh) if self.mesh is not None else nullcontext()
-        import warnings
-
         t0 = time.perf_counter()
         with self.tracer.span(
             "serve.compile", bucket=bucket, batch=batch,
             **({"mesh": self.mesh_desc} if self.mesh_desc else {}),
         ):
-            with warnings.catch_warnings():
-                # feature buffers are int/bool and the outputs are f32
-                # coords, so XLA cannot ALIAS the donation (and says so per
-                # compile); donating still lets the runtime release the
-                # request buffers during execution, which is the point on
-                # HBM-tight serving
-                warnings.filterwarnings(
-                    "ignore", message="Some donated buffers were not usable"
-                )
+            # capture the compile's warnings instead of suppressing them
+            # blind: the "Some donated buffers were not usable" notice is
+            # expected (feature buffers are int/bool, outputs f32 coords —
+            # XLA cannot ALIAS the donation; donating still lets the
+            # runtime release the request buffers during execution, the
+            # point on HBM-tight serving) and is STRUCTURED into the
+            # compile record below so tests can assert the donation intent
+            # actually reached XLA; everything else is re-emitted.
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
                 from alphafold2_tpu.ops.kernels import use_kernel_policy
 
                 with ctx, use_kernel_policy(self.kernel_policy):
@@ -452,6 +499,15 @@ class ServeEngine:
                         .lower(self.params, *abstract)
                         .compile()
                     )
+        donation_notes = [
+            w for w in caught
+            if "donated buffers were not usable" in str(w.message)
+        ]
+        for w in caught:
+            if w not in donation_notes:
+                warnings.warn_explicit(
+                    w.message, w.category, w.filename, w.lineno
+                )
         self.counters.bump("serve.compiles")
         costs = executable_costs(compiled)  # flops/bytes via observe.flops
         self._exe_flops[key] = costs["flops"] or 0.0
@@ -484,6 +540,15 @@ class ServeEngine:
         self.compile_records.append({
             "bucket": bucket, "batch": batch,
             "seconds": round(time.perf_counter() - t0, 4),
+            # donation audit: how many argument buffers we asked XLA to
+            # donate, and how many shapes XLA reported back as unaliasable
+            # (counted off the warning text) — a silently-dropped donation
+            # would show up as donated_args without any unusable report
+            # AND without aliasing, which tests/test_serve_pipeline.py pins
+            **({"donated_args": len(donate)} if donate else {}),
+            **({"donation_unusable":
+                str(donation_notes[0].message).count("ShapedArray")}
+               if donate and donation_notes else {}),
             **({"mesh": self.mesh_desc} if self.mesh_desc else {}),
             # precision/kernel keys ride only when non-default so records
             # (and the committed baselines) predating them stay comparable
@@ -519,6 +584,166 @@ class ServeEngine:
             f32((batch, self.msa_depth, bucket), jnp.bool_),  # msa_mask
         )
 
+    # --------------------------------------------------- dispatch stages
+    # Shared by the serial path (_dispatch_inner) and the pipelined path
+    # (serve/pipeline.py stage workers), so the two produce byte-identical
+    # results by construction — same featurize, same stacking, same
+    # executable, same fetch.
+
+    def _padded_batch(self, bucket: int, n_real: int) -> int:
+        """Batch-dim size a chunk of ``n_real`` requests dispatches at:
+        padded to the bucket's batch target (serve.pad_batches) and rounded
+        up to the mesh's dp multiple for even batch sharding."""
+        batch = (
+            self.batch_for(bucket) if self.cfg.serve.pad_batches else n_real
+        )
+        if self.mesh is not None:
+            n_dp = dict(
+                zip(self.mesh.axis_names, self.mesh.devices.shape)
+            ).get(DATA_AXIS, 1)
+            batch += (-batch) % n_dp
+        return batch
+
+    def _featurize_one(self, bucket: int, req: ServeRequest) -> dict:
+        tokens = encode_sequence(req.seq)[0]
+        item = featurize_bucketed(
+            tokens, bucket, self.msa_depth, seed=req.seed
+        )
+        pad = bucket - len(req.seq)
+        self.counters.bump("serve.padded_residues", pad)
+        self.histograms["pad_ratio"].observe(pad / bucket)
+        return item
+
+    def _dummy_item(self, bucket: int) -> dict:
+        """A fully-masked batch-padding slot."""
+        return {
+            "seq": np.full(bucket, constants.AA_PAD_INDEX, np.int32),
+            "mask": np.zeros(bucket, bool),
+            "msa": np.full(
+                (self.msa_depth, bucket), constants.AA_PAD_INDEX, np.int32
+            ),
+            "msa_mask": np.zeros((self.msa_depth, bucket), bool),
+        }
+
+    def _stack_host(self, bucket: int, items: list, batch: int) -> dict:
+        full = items + [
+            self._dummy_item(bucket) for _ in range(batch - len(items))
+        ]
+        return {k: np.stack([it[k] for it in full]) for k in full[0]}
+
+    def _transfer(self, host: dict, dispatch_index: int, bucket: int):
+        """Explicit host->device transfer: handing raw numpy to the
+        executable would be an implicit transfer, which the transfer-guard
+        test fixtures (tests/conftest.py) and
+        ``jax.transfer_guard("disallow")`` deployments reject. Under a mesh
+        the transfer carries its sharding explicitly — batch split over dp
+        at the host boundary, never an all-replicated copy that GSPMD
+        reshards later."""
+        if self.faults is not None:
+            self.faults.on_stage("transfer", dispatch_index, bucket)
+        if self.mesh is not None:
+            dp = NamedSharding(self.mesh, P(DATA_AXIS))
+            return {k: jax.device_put(a, dp) for k, a in host.items()}
+        return jax.device_put(host)
+
+    def _execute_batch(self, compiled, stacked, dispatch_index, bucket):
+        """Invoke the executable; under async dispatch (CPU and TPU alike)
+        the call returns while XLA executes in the background — blocking
+        is the fetch stage's job."""
+        if self.faults is not None:
+            self.faults.on_stage("compute", dispatch_index, bucket)
+        params = (
+            self._sharded_params() if self.mesh is not None else self.params
+        )
+        return compiled(
+            params, stacked["seq"], stacked["msa"],
+            stacked["mask"], stacked["msa_mask"],
+        )
+
+    def _fetch(self, out, dispatch_index, bucket):
+        """ONE blocking device_get of the whole output tree (one transfer
+        issued, not three serial ones), closing on device completion."""
+        if self.faults is not None:
+            self.faults.on_stage("fetch", dispatch_index, bucket)
+        fetched = jax.device_get(out)
+        refined = np.asarray(fetched["refined"])
+        weights = np.asarray(fetched["weights"])
+        disto = (
+            np.asarray(fetched["distogram"])
+            if "distogram" in fetched else None
+        )
+        return refined, weights, disto
+
+    def _exe_key(self, bucket: int, batch: int) -> tuple:
+        return (bucket, batch, self.mesh_desc, self.serve_dtype,
+                self.kernels_desc)
+
+    def _account_flops(self, exe_key) -> None:
+        # executed-flops accumulators are shared with the pipeline's
+        # completion worker, hence the lock
+        with self._account_lock:
+            self.executed_flops += self._exe_flops.get(exe_key, 0.0)
+            for kernel, flops in self._exe_breakdown.get(
+                exe_key, {}
+            ).items():
+                self.executed_flops_breakdown[kernel] = (
+                    self.executed_flops_breakdown.get(kernel, 0.0) + flops
+                )
+
+    def _build_results(
+        self, bucket, reqs, waits, dispatch_s, refined, weights, disto
+    ) -> list:
+        """Unpad/realize one batch's outputs into per-request results."""
+        built = []
+        for slot, req in enumerate(reqs):
+            L = len(req.seq)
+            atom14 = refined[slot, :L]
+            wait = max(0.0, waits[slot])
+            latency = wait + dispatch_s
+            self.histograms["latency_s"].observe(latency)
+            built.append(ServeResult(
+                seq=req.seq,
+                bucket=bucket,
+                atom14=atom14,
+                backbone=atom14[:, :3],
+                weights=weights[slot, : 3 * L, : 3 * L],
+                distogram=(
+                    disto[slot, : 3 * L, : 3 * L]
+                    if disto is not None else None
+                ),
+                latency_s=latency,
+                queue_wait_s=wait,
+                dispatch_s=dispatch_s,
+                trace_id=req.trace.trace_id if req.trace else None,
+            ))
+        return built
+
+    def _error_results(self, bucket, reqs, waits, msg, dispatch_s) -> list:
+        """Structured per-request error results for a failed batch (the
+        scheduler retries them against a different executable)."""
+        self.counters.bump("serve.dispatch_errors")
+        rec = flightrec.active()
+        if rec is not None:  # preserve the telemetry leading up to it
+            rec.note(
+                "dispatch_error", bucket=int(bucket), error=msg,
+                n_real=len(reqs),
+                trace_ids=[r.trace.trace_id for r in reqs if r.trace],
+            )
+            rec.dump("dispatch_error")  # once per process (deduped)
+        return [
+            ServeResult(
+                seq=req.seq,
+                bucket=bucket,
+                status="error",
+                error=msg,
+                latency_s=max(0.0, waits[slot]) + dispatch_s,
+                queue_wait_s=max(0.0, waits[slot]),
+                dispatch_s=dispatch_s,
+                trace_id=req.trace.trace_id if req.trace else None,
+            )
+            for slot, req in enumerate(reqs)
+        ]
+
     # -------------------------------------------------------------- serving
 
     def predict_many(
@@ -540,6 +765,25 @@ class ServeEngine:
 
         results: list = [None] * len(reqs)
         arrival = time.perf_counter()  # queue-wait origin for this stream
+        if self.pipeline is not None:
+            # pipelined path: every chunk is submitted up front, so the
+            # host stage featurizes/transfers batch N+1 while batch N
+            # computes and batch N-1's results fetch; submit() blocks at
+            # pipeline_depth in flight (backpressure), result() drains in
+            # submission order
+            handles = []
+            for bucket in sorted(by_bucket):
+                order = by_bucket[bucket]
+                step = self.batch_for(bucket)
+                for lo in range(0, len(order), step):
+                    chunk = order[lo : lo + step]
+                    handles.append((chunk, self.pipeline.submit(
+                        bucket, [reqs[i] for i in chunk], arrival=arrival
+                    )))
+            for chunk, handle in handles:
+                for idx, res in zip(chunk, handle.result()):
+                    results[idx] = res
+            return results
         for bucket in sorted(by_bucket):
             order = by_bucket[bucket]
             step = self.batch_for(bucket)
@@ -563,6 +807,27 @@ class ServeEngine:
         self._dispatch(bucket, reqs, list(range(len(reqs))), results)
         return results
 
+    def dispatch_batch_async(
+        self,
+        bucket: int,
+        requests: Sequence[Union[str, ServeRequest]],
+        joinable: bool = False,
+    ):
+        """Pipelined dispatch of one pre-formed batch: returns a
+        :class:`~alphafold2_tpu.serve.pipeline.DispatchHandle` future over
+        the ordered result list instead of blocking through featurize /
+        compute / fetch. With ``joinable=True`` the batch stays open to
+        ``handle.try_join(req)`` while its host stage runs — the
+        scheduler's in-flight admission (continuous batching). Requires
+        ``serve.pipeline_depth > 0``."""
+        if self.pipeline is None:
+            raise RuntimeError(
+                "pipelined dispatch requires serve.pipeline_depth > 0"
+            )
+        return self.pipeline.submit(
+            bucket, [_as_request(r) for r in requests], joinable=joinable
+        )
+
     def retry_bucket(self, bucket: int) -> Optional[int]:
         """The next rung up the ladder — a *different* (bucket, batch)
         executable for the scheduler's retry-with-exclusion path — or None
@@ -572,14 +837,7 @@ class ServeEngine:
 
     def _dispatch(self, bucket, chunk_reqs, chunk_idx, results, arrival=None):
         n_real = len(chunk_reqs)
-        batch = self.batch_for(bucket) if self.cfg.serve.pad_batches else n_real
-        if self.mesh is not None:
-            # the batch axis shards evenly over dp: round partial chunks up
-            # to the next dp multiple with masked dummy slots
-            n_dp = dict(
-                zip(self.mesh.axis_names, self.mesh.devices.shape)
-            ).get(DATA_AXIS, 1)
-            batch += (-batch) % n_dp
+        batch = self._padded_batch(bucket, n_real)
         dispatch_index = self.counters.bump("serve.batches")
         self.counters.bump("serve.padded_slots", batch - n_real)
         t_start = time.perf_counter()
@@ -604,30 +862,13 @@ class ServeEngine:
             # counters already bumped: every request gets a structured
             # per-request error result the scheduler can retry against a
             # different (bucket, batch) executable
-            self.counters.bump("serve.dispatch_errors")
             msg = f"{type(e).__name__}: {e}"
             dispatch_s = time.perf_counter() - t_start
-            rec = flightrec.active()
-            if rec is not None:  # preserve the telemetry leading up to it
-                rec.note(
-                    "dispatch_error", bucket=int(bucket), error=msg,
-                    n_real=len(chunk_reqs),
-                    trace_ids=[
-                        r.trace.trace_id for r in chunk_reqs if r.trace
-                    ],
-                )
-                rec.dump("dispatch_error")  # once per process (deduped)
-            for slot, (req, idx) in enumerate(zip(chunk_reqs, chunk_idx)):
-                results[idx] = ServeResult(
-                    seq=req.seq,
-                    bucket=bucket,
-                    status="error",
-                    error=msg,
-                    latency_s=max(0.0, waits[slot]) + dispatch_s,
-                    queue_wait_s=max(0.0, waits[slot]),
-                    dispatch_s=dispatch_s,
-                    trace_id=req.trace.trace_id if req.trace else None,
-                )
+            errs = self._error_results(
+                bucket, chunk_reqs, waits, msg, dispatch_s
+            )
+            for idx, res in zip(chunk_idx, errs):
+                results[idx] = res
 
     def _dispatch_inner(
         self, bucket, batch, dispatch_index, chunk_reqs, chunk_idx, results,
@@ -641,47 +882,16 @@ class ServeEngine:
         member_traces = [r.trace.trace_id for r in chunk_reqs if r.trace]
         with self.tracer.span(
             "serve.batch", bucket=bucket, batch=batch, n_real=n_real,
+            dispatch_index=dispatch_index,
             **({"trace_ids": member_traces} if member_traces else {}),
         ) as batch_span:
-            with self.tracer.span("serve.featurize", bucket=bucket):
-                items = []
-                for r in chunk_reqs:
-                    tokens = encode_sequence(r.seq)[0]
-                    items.append(
-                        featurize_bucketed(
-                            tokens, bucket, self.msa_depth, seed=r.seed
-                        )
-                    )
-                    pad = bucket - len(r.seq)
-                    self.counters.bump("serve.padded_residues", pad)
-                    self.histograms["pad_ratio"].observe(pad / bucket)
-                for _ in range(batch - n_real):  # fully-masked dummy slots
-                    items.append({
-                        "seq": np.full(
-                            bucket, constants.AA_PAD_INDEX, np.int32
-                        ),
-                        "mask": np.zeros(bucket, bool),
-                        "msa": np.full(
-                            (self.msa_depth, bucket), constants.AA_PAD_INDEX,
-                            np.int32,
-                        ),
-                        "msa_mask": np.zeros((self.msa_depth, bucket), bool),
-                    })
-                # explicit host->device transfer: handing raw numpy to the
-                # executable would be an implicit transfer, which the
-                # transfer-guard test fixtures (tests/conftest.py) and
-                # jax.transfer_guard("disallow") deployments reject. Under
-                # a mesh the transfer carries its sharding explicitly —
-                # batch split over dp at the host boundary, never an
-                # all-replicated copy that GSPMD reshards later.
-                host = {k: np.stack([it[k] for it in items]) for k in items[0]}
-                if self.mesh is not None:
-                    dp = NamedSharding(self.mesh, P(DATA_AXIS))
-                    stacked = {
-                        k: jax.device_put(a, dp) for k, a in host.items()
-                    }
-                else:
-                    stacked = jax.device_put(host)
+            with self.tracer.span(
+                "serve.featurize", bucket=bucket,
+                dispatch_index=dispatch_index,
+            ):
+                items = [self._featurize_one(bucket, r) for r in chunk_reqs]
+                host = self._stack_host(bucket, items, batch)
+                stacked = self._transfer(host, dispatch_index, bucket)
 
             with self.tracer.span(
                 "serve.get_executable", bucket=bucket, batch=batch
@@ -693,79 +903,108 @@ class ServeEngine:
                 )
 
             t0 = time.perf_counter()
-            params = (
-                self._sharded_params() if self.mesh is not None
-                else self.params
-            )
             with self.tracer.span(
                 "serve.dispatch", bucket=bucket,
+                dispatch_index=dispatch_index,
                 **({"mesh": self.mesh_desc} if self.mesh_desc else {}),
             ):
-                out = compiled(
-                    params, stacked["seq"], stacked["msa"],
-                    stacked["mask"], stacked["msa_mask"],
+                out = self._execute_batch(
+                    compiled, stacked, dispatch_index, bucket
                 )
             # fetch the values, not just readiness: the timed region must
             # close on device completion (the bench's validity contract)
-            with self.tracer.span("serve.device_get", bucket=bucket):
-                refined = np.asarray(jax.device_get(out["refined"]))
-                weights = np.asarray(jax.device_get(out["weights"]))
-                disto = (
-                    np.asarray(jax.device_get(out["distogram"]))
-                    if "distogram" in out else None
+            with self.tracer.span(
+                "serve.device_get", bucket=bucket,
+                dispatch_index=dispatch_index,
+            ):
+                refined, weights, disto = self._fetch(
+                    out, dispatch_index, bucket
                 )
             dispatch_s = time.perf_counter() - t0
             batch_span.set(dispatch_s=round(dispatch_s, 4))
             self.histograms["dispatch_s"].observe(dispatch_s)
-            exe_key = (bucket, batch, self.mesh_desc, self.serve_dtype,
-                       self.kernels_desc)
-            self.executed_flops += self._exe_flops.get(exe_key, 0.0)
-            for kernel, flops in self._exe_breakdown.get(
-                exe_key, {}
-            ).items():
-                self.executed_flops_breakdown[kernel] = (
-                    self.executed_flops_breakdown.get(kernel, 0.0) + flops
-                )
+            self._account_flops(self._exe_key(bucket, batch))
             self.memory.counter_to(self.tracer)  # HBM beside the spans
 
-            with self.tracer.span("serve.unpad", bucket=bucket):
-                for slot, (req, idx) in enumerate(
-                    zip(chunk_reqs, chunk_idx)
-                ):
-                    L = len(req.seq)
-                    atom14 = refined[slot, :L]
-                    wait = max(0.0, waits[slot])
-                    latency = wait + dispatch_s
-                    self.histograms["latency_s"].observe(latency)
-                    results[idx] = ServeResult(
-                        seq=req.seq,
-                        bucket=bucket,
-                        atom14=atom14,
-                        backbone=atom14[:, :3],
-                        weights=weights[slot, : 3 * L, : 3 * L],
-                        distogram=(
-                            disto[slot, : 3 * L, : 3 * L]
-                            if disto is not None else None
-                        ),
-                        latency_s=latency,
-                        queue_wait_s=wait,
-                        dispatch_s=dispatch_s,
-                        trace_id=(
-                            req.trace.trace_id if req.trace else None
-                        ),
-                    )
+            with self.tracer.span(
+                "serve.unpad", bucket=bucket, dispatch_index=dispatch_index
+            ):
+                built = self._build_results(
+                    bucket, chunk_reqs, waits, dispatch_s,
+                    refined, weights, disto,
+                )
+            for idx, res in zip(chunk_idx, built):
+                results[idx] = res
+
+    # ------------------------------------------------- pipelined completion
+
+    def _complete_pipelined(self, job) -> list:
+        """Completion stage of the pipelined dispatch (runs on the fetch
+        worker): accounting + unpad/realize into ordered ServeResults.
+        Always returns one result per member — an error carried from any
+        stage becomes structured per-request error results, so a poisoned
+        batch cannot wedge the completion thread."""
+        t_end = time.perf_counter()
+        reqs = job.members
+        t0 = job.t_device0 if job.t_device0 is not None else t_end
+        dispatch_s = max(0.0, t_end - t0)
+        # queue wait runs from arrival to DEVICE dispatch: under the
+        # pipeline, host featurize/transfer is pre-device residency the
+        # request observes as waiting, and wait + dispatch_s spans the
+        # whole arrival->completion interval
+        waits = []
+        for r in reqs:
+            origin = r.arrival_s if r.arrival_s is not None else job.arrival
+            waits.append(t0 - origin if origin is not None else 0.0)
+            self.histograms["queue_wait_s"].observe(max(0.0, waits[-1]))
+        if job.error is not None:
+            msg = f"{type(job.error).__name__}: {job.error}"
+            return self._error_results(
+                job.bucket, reqs, waits, msg, dispatch_s
+            )
+        self.histograms["batch_occupancy"].observe(
+            job.n_real / job.batch_size
+        )
+        self.histograms["dispatch_s"].observe(dispatch_s)
+        self._account_flops(self._exe_key(job.bucket, job.batch_size))
+        self.memory.counter_to(self.tracer)
+        refined, weights, disto = job.fetched
+        with self.tracer.span(
+            "serve.unpad", bucket=job.bucket, dispatch_index=job.index
+        ):
+            built = self._build_results(
+                job.bucket, reqs, waits, dispatch_s, refined, weights, disto
+            )
+        member_traces = [r.trace.trace_id for r in reqs if r.trace]
+        # the batch span is retroactive (its start predates this thread's
+        # involvement); explicit bounds keep the Chrome timeline honest
+        self.tracer.span_event(
+            "serve.batch",
+            job.t_host0 if job.t_host0 is not None else t0, t_end,
+            bucket=job.bucket, batch=job.batch_size, n_real=job.n_real,
+            dispatch_index=job.index, dispatch_s=round(dispatch_s, 4),
+            pipelined=True,
+            **({"trace_ids": member_traces} if member_traces else {}),
+        )
+        return built
+
+    def _completion_fallback(self, job) -> list:
+        """Last-resort error results if completion itself raised — the
+        future always resolves with one result per member."""
+        msg = f"{type(job.error).__name__}: {job.error}"
+        return [
+            ServeResult(
+                seq=req.seq, bucket=job.bucket, status="error", error=msg,
+                trace_id=req.trace.trace_id if req.trace else None,
+            )
+            for req in job.members
+        ]
 
     def warmup(self) -> dict:
         """Compile every ladder rung ahead of traffic (one dummy dispatch
         per bucket). Returns the counter snapshot afterwards."""
         for bucket in self.buckets:
-            batch = self.batch_for(bucket) if self.cfg.serve.pad_batches else 1
-            if self.mesh is not None:  # same dp rounding as _dispatch
-                n_dp = dict(
-                    zip(self.mesh.axis_names, self.mesh.devices.shape)
-                ).get(DATA_AXIS, 1)
-                batch += (-batch) % n_dp
-            self._get_executable(bucket, batch)
+            self._get_executable(bucket, self._padded_batch(bucket, 1))
         return self.counters.snapshot()
 
     def stats(self) -> dict:

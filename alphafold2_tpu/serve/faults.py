@@ -14,7 +14,11 @@ Plans target a specific dispatch index (``fail_dispatch=N``, 1-based over
 the engine's ``serve.batches`` counter) or every dispatch of a bucket
 (``fail_bucket=B``), and fire at most ``times`` times (0 = unlimited), so
 "the first dispatch of bucket 8 fails once, the retry succeeds" is a
-deterministic scenario instead of a race. Pure stdlib, no jax.
+deterministic scenario instead of a race. ``fail_stage`` moves the
+injection point from the top of the dispatch into a specific pipeline
+stage (``transfer`` = host device_put, ``compute`` = executable call,
+``fetch`` = result device_get), so the pipelined dispatch path's
+error routing is exercised stage by stage. Pure stdlib, no jax.
 """
 
 from __future__ import annotations
@@ -47,8 +51,18 @@ class FaultPlan:
     delay_s: float = 0.0  # sleep before (optionally) failing
     fail: bool = True  # False = delay-only plan
     message: str = "injected fault"
+    # pipeline stage to hit: "transfer" | "compute" | "fetch"; None keeps
+    # the legacy injection point at the top of the dispatch (pre-featurize)
+    fail_stage: Optional[str] = None
+
+    _STAGES = ("transfer", "compute", "fetch")
 
     def __post_init__(self):
+        if self.fail_stage is not None and self.fail_stage not in self._STAGES:
+            raise ValueError(
+                f"fail_stage must be one of {self._STAGES}, "
+                f"got {self.fail_stage!r}"
+            )
         self._lock = threading.Lock()
         self.fired: list = []
 
@@ -60,25 +74,48 @@ class FaultPlan:
         return self.fail_bucket is not None and bucket == self.fail_bucket
 
     def on_dispatch(self, dispatch_index: int, bucket: int) -> None:
-        """Engine hook: called once per dispatch before any device work."""
+        """Engine hook: called once per dispatch before any device work.
+
+        Inert when ``fail_stage`` is set — a staged plan fires from its
+        stage hook instead, keeping exactly one injection point per plan."""
+        if self.fail_stage is None:
+            self._fire(dispatch_index, bucket, stage=None)
+
+    def on_stage(self, stage: str, dispatch_index: int, bucket: int) -> None:
+        """Engine hook: called as the named pipeline stage begins.
+
+        Only plans whose ``fail_stage`` names this stage fire; everything
+        else (including legacy top-of-dispatch plans) passes through."""
+        if self.fail_stage == stage:
+            self._fire(dispatch_index, bucket, stage=stage)
+
+    def _fire(
+        self, dispatch_index: int, bucket: int, stage: Optional[str]
+    ) -> None:
         with self._lock:
             if self.times and len(self.fired) >= self.times:
                 return
             if not self._matches(dispatch_index, bucket):
                 return
-            self.fired.append({"dispatch": dispatch_index, "bucket": bucket})
+            record = {"dispatch": dispatch_index, "bucket": bucket}
+            if stage is not None:
+                record["stage"] = stage
+            self.fired.append(record)
         if self.delay_s > 0:
             time.sleep(self.delay_s)
         if self.fail:
+            where = f" at {stage}" if stage is not None else ""
             raise InjectedFault(
-                f"{self.message} (dispatch {dispatch_index}, bucket {bucket})"
+                f"{self.message}{where} "
+                f"(dispatch {dispatch_index}, bucket {bucket})"
             )
 
     @classmethod
     def from_spec(cls, spec: Optional[str]) -> Optional["FaultPlan"]:
-        """Parse ``"dispatch=2,bucket=16,times=1,delay=0.5,fail=0"`` specs
-        (any subset of keys) — the ``AF2TPU_SERVE_ASYNC_FAULT`` env hook the
-        serve-async bench uses for degradation drills. None/"" -> None."""
+        """Parse ``"dispatch=2,bucket=16,times=1,delay=0.5,fail=0,
+        stage=compute"`` specs (any subset of keys) — the
+        ``AF2TPU_SERVE_ASYNC_FAULT`` env hook the serve-async bench uses
+        for degradation drills. None/"" -> None."""
         if not spec:
             return None
         kw: dict = {}
@@ -95,6 +132,8 @@ class FaultPlan:
                 kw["delay_s"] = float(value)
             elif key == "fail":
                 kw["fail"] = value.strip() not in ("0", "false", "no")
+            elif key == "stage":
+                kw["fail_stage"] = value.strip()
             else:
                 raise ValueError(f"unknown fault-spec key {key!r} in {spec!r}")
         return cls(**kw)

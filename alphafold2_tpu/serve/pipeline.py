@@ -1,0 +1,309 @@
+"""Double-buffered host/device dispatch pipeline for ServeEngine.
+
+The serial dispatch path runs featurize -> device_put -> compute ->
+device_get -> unpad in one thread, so the device idles during every host
+phase and the host idles while the device computes. This module overlaps
+them: three single-worker stages connected as a pipeline, with at most
+``serve.pipeline_depth`` batches in flight,
+
+    host stage    featurize + stack + device_put of batch N+1
+    device stage  executable lookup + dispatch of batch N (async on CPU/TPU:
+                  the call returns while XLA executes in the background)
+    fetch stage   ONE blocking device_get of batch N-1's whole output tree,
+                  then unpad/realize + future resolution (completion)
+
+``submit`` returns a :class:`DispatchHandle` future immediately; the
+caller blocks only in ``result()``. Each stage worker is a one-thread
+``concurrent.futures.ThreadPoolExecutor`` so per-stage ordering is the
+submission order (batch N's compute is always enqueued before batch
+N+1's) while different stages run concurrently on different batches.
+
+While a batch sits in the host stage its formation is still *open*: the
+scheduler's in-flight admission joins late-arriving requests into it via
+:meth:`PipelineBatch.try_join` until the featurize loop drains and seals
+the membership (continuous batching — the real admission window is the
+host stage's duration, not a dwell timer).
+
+Failure routing: an exception in any stage (including injected
+``serve.faults`` stage faults) is carried on the job to the completion
+stage, which converts it into structured per-request error results and
+resolves the future — the completion worker can never wedge on a
+poisoned batch, and the in-flight slot is always released.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+
+class PipelineBatch:
+    """One batch's membership while it forms in the host stage.
+
+    ``try_join`` admits a request while the formation is open (the host
+    worker has not drained the member list) and below ``fill``; the host
+    worker pulls members one at a time via :meth:`next_member`, which
+    seals the formation the first time it finds nothing left to
+    featurize. Thread-safe; joiners and the host worker race only on the
+    member list, never on featurized data.
+    """
+
+    def __init__(self, bucket: int, requests: list, fill: int):
+        self.bucket = int(bucket)
+        self.fill = max(len(requests), int(fill), 1)
+        self._lock = threading.Lock()
+        self._members = list(requests)
+        self._sealed = False
+
+    def try_join(self, req) -> bool:
+        """Admit ``req`` into this in-flight batch; False once sealed/full."""
+        with self._lock:
+            if self._sealed or len(self._members) >= self.fill:
+                return False
+            self._members.append(req)
+            return True
+
+    def next_member(self, i: int):
+        """Member ``i`` if admitted, else seal the formation and return
+        None — called only by the host worker, with ``i`` = number of
+        members it has already featurized."""
+        with self._lock:
+            if i < len(self._members):
+                return self._members[i]
+            self._sealed = True
+            return None
+
+    def seal(self) -> None:
+        with self._lock:
+            self._sealed = True
+
+    @property
+    def sealed(self) -> bool:
+        with self._lock:
+            return self._sealed
+
+    @property
+    def members(self) -> list:
+        with self._lock:
+            return list(self._members)
+
+
+class DispatchHandle:
+    """Future over one pipelined batch's ordered ServeResult list."""
+
+    def __init__(self, batch: PipelineBatch):
+        self.batch = batch
+        self._done = threading.Event()
+        self._cb_lock = threading.Lock()
+        self._results: Optional[list] = None
+        self._callbacks: list = []
+
+    def try_join(self, req) -> bool:
+        """Admit ``req`` into the batch while its host stage still runs."""
+        return self.batch.try_join(req)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> list:
+        """Block until the batch completes; returns one ServeResult per
+        member in admission order (initial requests, then joiners)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"pipelined dispatch (bucket {self.batch.bucket}) did not "
+                f"complete within {timeout}s"
+            )
+        return self._results
+
+    def add_done_callback(self, fn) -> None:
+        """Run ``fn(results)`` on completion — immediately (caller thread)
+        if already resolved, else on the completion worker."""
+        with self._cb_lock:
+            if self._results is None:
+                self._callbacks.append(fn)
+                return
+        fn(self._results)
+
+    def _resolve(self, results: list) -> None:
+        with self._cb_lock:
+            self._results = results
+            callbacks = list(self._callbacks)
+            self._callbacks.clear()
+        # callbacks run BEFORE the done event: ``result()`` returning means
+        # the batch is fully settled — the scheduler's completion callback
+        # (retry, cache fulfil, sched.resolve trace terminals) has finished,
+        # so a caller may close the frontend/tracer the moment it unblocks
+        for fn in callbacks:
+            try:
+                fn(results)
+            except Exception:
+                pass  # a broken observer must not wedge the completion worker
+        self._done.set()
+
+
+class _Job:
+    """Mutable per-batch state riding through the three stages."""
+
+    __slots__ = (
+        "bucket", "index", "arrival", "batch", "handle", "members",
+        "n_real", "batch_size", "stacked", "compiled", "out", "fetched",
+        "error", "t_host0", "t_device0",
+    )
+
+    def __init__(self, bucket: int, index: int, arrival, batch, handle):
+        self.bucket = bucket
+        self.index = index  # global 1-based dispatch index (serve.batches)
+        self.arrival = arrival  # stream-level queue-wait origin (fallback)
+        self.batch = batch
+        self.handle = handle
+        self.members: list = []
+        self.n_real = 0
+        self.batch_size = 0
+        self.stacked = None
+        self.compiled = None
+        self.out = None
+        self.fetched = None
+        self.error: Optional[BaseException] = None
+        self.t_host0: Optional[float] = None
+        self.t_device0: Optional[float] = None
+
+
+class PipelinedDispatcher:
+    """The pipeline over one :class:`~alphafold2_tpu.serve.engine.
+    ServeEngine`: owns the three stage workers and the in-flight bound.
+
+    ``depth`` batches may be in flight at once (2 = classic double
+    buffering: the host featurizes N+1 while the device computes N);
+    ``submit`` blocks once the bound is reached, which is the pipeline's
+    backpressure toward the caller.
+    """
+
+    def __init__(self, engine, depth: int = 2):
+        self.engine = engine
+        self.depth = max(1, int(depth))
+        self._slots = threading.BoundedSemaphore(self.depth)
+        self._host = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="af2-pipe-host"
+        )
+        self._device = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="af2-pipe-device"
+        )
+        self._fetch = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="af2-pipe-fetch"
+        )
+
+    def submit(
+        self, bucket: int, requests: list, arrival=None, joinable: bool = False
+    ) -> DispatchHandle:
+        """Enqueue one batch; returns its future. ``joinable`` keeps the
+        formation open to ``try_join`` up to the engine's batch target
+        while the host stage runs (the scheduler's in-flight admission);
+        a pre-formed batch (predict_many chunks) stays closed."""
+        eng = self.engine
+        fill = eng.batch_for(bucket) if joinable else len(requests)
+        batch = PipelineBatch(bucket, list(requests), fill=fill)
+        handle = DispatchHandle(batch)
+        self._slots.acquire()  # backpressure: <= depth batches in flight
+        index = eng.counters.bump("serve.batches")
+        job = _Job(bucket, index, arrival, batch, handle)
+        self._host.submit(self._host_stage, job)
+        return handle
+
+    # ----------------------------------------------------------- the stages
+
+    def _host_stage(self, job: _Job) -> None:
+        eng = self.engine
+        try:
+            job.t_host0 = time.perf_counter()
+            if eng.faults is not None:
+                # legacy top-of-dispatch injection point (fail_stage=None
+                # plans); staged plans fire from the stage helpers below
+                eng.faults.on_dispatch(job.index, job.bucket)
+            with eng.tracer.span(
+                "serve.featurize", bucket=job.bucket,
+                dispatch_index=job.index,
+            ):
+                items: list = []
+                while True:  # drain members; joiners may land mid-loop
+                    req = job.batch.next_member(len(items))
+                    if req is None:
+                        break  # nothing left unfeaturized: formation sealed
+                    items.append(eng._featurize_one(job.bucket, req))
+            job.members = job.batch.members
+            job.n_real = len(job.members)
+            job.batch_size = eng._padded_batch(job.bucket, job.n_real)
+            eng.counters.bump(
+                "serve.padded_slots", job.batch_size - job.n_real
+            )
+            with eng.tracer.span(
+                "serve.device_put", bucket=job.bucket,
+                dispatch_index=job.index,
+            ):
+                host = eng._stack_host(job.bucket, items, job.batch_size)
+                job.stacked = eng._transfer(host, job.index, job.bucket)
+        except BaseException as e:  # carried to completion, never raised
+            job.batch.seal()
+            job.members = job.batch.members
+            job.error = e
+        self._device.submit(self._device_stage, job)
+
+    def _device_stage(self, job: _Job) -> None:
+        eng = self.engine
+        try:
+            if job.error is None:
+                with eng.tracer.span(
+                    "serve.get_executable", bucket=job.bucket,
+                    batch=job.batch_size,
+                ) as exe_span:
+                    before = eng.counters.get("serve.compiles")
+                    job.compiled = eng._get_executable(
+                        job.bucket, job.batch_size
+                    )
+                    exe_span.set(
+                        compiled_now=eng.counters.get("serve.compiles")
+                        > before
+                    )
+                job.t_device0 = time.perf_counter()
+                with eng.tracer.span(
+                    "serve.dispatch", bucket=job.bucket,
+                    dispatch_index=job.index,
+                    **({"mesh": eng.mesh_desc} if eng.mesh_desc else {}),
+                ):
+                    # async dispatch: returns as soon as XLA enqueues the
+                    # execution; the fetch stage's device_get rides the tail
+                    job.out = eng._execute_batch(
+                        job.compiled, job.stacked, job.index, job.bucket
+                    )
+                job.stacked = None  # let donated input buffers release
+        except BaseException as e:
+            job.error = e
+        self._fetch.submit(self._fetch_stage, job)
+
+    def _fetch_stage(self, job: _Job) -> None:
+        eng = self.engine
+        try:
+            if job.error is None:
+                with eng.tracer.span(
+                    "serve.device_get", bucket=job.bucket,
+                    dispatch_index=job.index,
+                ):
+                    job.fetched = eng._fetch(job.out, job.index, job.bucket)
+                job.out = None
+        except BaseException as e:
+            job.error = e
+        try:
+            results = eng._complete_pipelined(job)
+        except BaseException as e:  # completion itself must never wedge
+            job.error = e
+            results = eng._completion_fallback(job)
+        finally:
+            self._slots.release()
+        job.handle._resolve(results)
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Stop the stage workers (in-flight batches finish when ``wait``)."""
+        self._host.shutdown(wait=wait)
+        self._device.shutdown(wait=wait)
+        self._fetch.shutdown(wait=wait)

@@ -13,8 +13,17 @@ needs between "user request arrives" and "bucketed batch hits the chip":
   slice of the queue under overload.
 - **Continuous batch formation** — a background dispatcher thread forms
   (bucket, batch) groups and dispatches when a group *fills* to
-  ``max_batch`` OR the oldest member has *dwelled* ``serve.dwell_ms`` —
-  the classic fill-vs-latency tradeoff, tunable per deployment.
+  ``max_batch`` OR the oldest member has *dwelled* ``serve.dwell_ms``
+  (:func:`~alphafold2_tpu.serve.bucketing.formation_ripe`) — the classic
+  fill-vs-latency tradeoff, tunable per deployment.
+- **In-flight admission (continuous batching)** — with the engine's
+  pipelined dispatch (``serve.pipeline_depth > 0``), a request arriving
+  while its bucket's previous formation is still in the *host stage*
+  joins that in-flight batch (``DispatchHandle.try_join``) instead of
+  queueing behind a fresh fill-or-dwell window; dispatches go through
+  ``engine.dispatch_batch_async`` and resolve from the pipeline's
+  completion worker, so the dispatcher thread never blocks on the device
+  and batch N+1 forms while batch N computes.
 - **Per-request deadlines** — a request whose deadline passes while
   queued resolves to a structured ``deadline_exceeded`` result instead of
   wasting a dispatch slot (or raising).
@@ -64,7 +73,7 @@ from alphafold2_tpu.observe.tracectx import (
     SUBMIT_EVENT,
     TraceContext,
 )
-from alphafold2_tpu.serve.bucketing import bucket_for
+from alphafold2_tpu.serve.bucketing import bucket_for, formation_ripe
 from alphafold2_tpu.serve.cache import ResultCache, result_key
 from alphafold2_tpu.serve.engine import (
     ServeEngine,
@@ -161,9 +170,21 @@ class AsyncServeFrontend:
             "time_to_dispatch_s": Histogram(),
             "dwell_s": Histogram(),
         }
+        # pipelined dispatch: present when the engine was built with
+        # serve.pipeline_depth > 0 (getattr so engine fakes in tests and
+        # older engine objects keep the sync path)
+        self.pipeline = getattr(engine, "pipeline", None)
+        self.inflight_admission = (
+            self.pipeline is not None
+            and bool(getattr(scfg, "inflight_admission", False))
+        )
         self._lock = threading.Condition()
         self._observers: list = []  # fn(result, priority) at every resolve
         self._queues: dict = {}  # bucket -> list[_Pending], priority-sorted
+        # bucket -> (DispatchHandle, [_Pending]) while that batch's host
+        # stage is still joinable; completion pops its own entry
+        self._forming: dict = {}
+        self._inflight: list = []  # DispatchHandles not yet completed
         self._depth = 0
         self._seq_no = 0
         self._ema_dispatch_s: Optional[float] = None
@@ -192,6 +213,17 @@ class AsyncServeFrontend:
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+        # drain pipelined in-flight batches first: their completion
+        # callbacks resolve the member handles (joiners included), so the
+        # leftover sweep below only sees what never got dispatched
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._lock:
+            inflight = list(self._inflight)
+        for dh in inflight:
+            try:
+                dh.result(timeout=max(0.1, deadline - time.monotonic()))
+            except TimeoutError:
+                break  # a wedged batch must not hang close(); sweep on
         leftovers = []
         with self._lock:
             for q in self._queues.values():
@@ -345,6 +377,31 @@ class AsyncServeFrontend:
 
         # leader: admission control under the scheduler lock
         with self._lock:
+            if self.inflight_admission:
+                # continuous batching: if this bucket's previous formation
+                # is still in the pipeline's host stage, join it instead of
+                # queueing behind a fresh fill-or-dwell window. No queue
+                # slot is consumed; the join races the host worker sealing
+                # the batch and simply falls through to normal admission
+                # when it loses. (Lock order: scheduler lock -> batch
+                # membership lock, never the reverse.)
+                forming = self._forming.get(bucket)
+                if forming is not None and forming[0].try_join(req):
+                    pending = _Pending(
+                        req=req, handle=handle, key=key, bucket=bucket,
+                        priority=priority, enqueued=now, deadline=None,
+                        seq_no=self._seq_no,
+                    )
+                    self._seq_no += 1
+                    forming[1].append(pending)
+                    self.counters.bump("sched.inflight_admitted")
+                    joined_trace = (
+                        tctx.child().event_args() if tctx is not None else {}
+                    )
+                    self.tracer.instant(
+                        "sched.inflight_admit", bucket=bucket, **joined_trace
+                    )
+                    return handle
             rejected = None
             if self._depth >= self.queue_depth:
                 rejected = ("queue full", "sched.rejected")
@@ -445,10 +502,10 @@ class AsyncServeFrontend:
                     expired.extend(dead)
                 fill = self.engine.batch_for(bucket)  # long rungs fill small
                 while q:
-                    ripe = len(q) >= fill or (
-                        now - min(p.enqueued for p in q) >= self.dwell_s
-                    )
-                    if not ripe:
+                    oldest = min(p.enqueued for p in q)
+                    if not formation_ripe(
+                        len(q), fill, now - oldest, self.dwell_s
+                    ):
                         break
                     take = q[:fill]
                     del q[: len(take)]
@@ -495,6 +552,9 @@ class AsyncServeFrontend:
                     "sched.queue", p.enqueued, formed_at, bucket=bucket,
                     **p.req.trace.child().event_args(),
                 )
+        if self.pipeline is not None:
+            self._execute_pipelined(bucket, pendings)
+            return
         reqs = [p.req for p in pendings]
         member_traces = [r.trace.trace_id for r in reqs if r.trace]
         t0 = self._clock()
@@ -511,7 +571,79 @@ class AsyncServeFrontend:
             dt if self._ema_dispatch_s is None
             else 0.8 * self._ema_dispatch_s + 0.2 * dt
         )
+        self._settle(bucket, pendings, results)
 
+    def _execute_pipelined(self, bucket: int, pendings: list) -> None:
+        """Hand one formed batch to the engine's pipeline and return
+        immediately — the dispatcher thread goes back to forming batch
+        N+1 while this one runs. While the batch's host stage runs, its
+        membership stays joinable and ``submit`` admits late arrivals into
+        it (the ``_forming`` registry); the pipeline's completion worker
+        calls :meth:`_finish_pipelined` with the ordered results."""
+        t0 = self._clock()
+        dh = self.engine.dispatch_batch_async(
+            bucket, [p.req for p in pendings],
+            joinable=self.inflight_admission,
+        )
+        entry = (dh, list(pendings))
+        with self._lock:
+            self._inflight.append(dh)
+            if self.inflight_admission:
+                self._forming[bucket] = entry
+        dh.add_done_callback(
+            lambda results: self._finish_pipelined(
+                bucket, dh, entry, t0, results
+            )
+        )
+
+    def _finish_pipelined(
+        self, bucket: int, dh, entry: tuple, t0: float, results: list
+    ) -> None:
+        """Completion callback (pipeline fetch worker thread): un-register
+        the batch, account the dispatch, retry failures synchronously, and
+        resolve every member — initial pendings plus in-flight joiners."""
+        with self._lock:
+            if self._forming.get(bucket) is entry:
+                del self._forming[bucket]
+            # joiners append under this lock before the batch seals, and
+            # sealing happens-before completion, so this snapshot is the
+            # full membership in the engine's result order
+            pendings = list(entry[1])
+        try:
+            dt = max(0.0, self._clock() - t0)
+            self._ema_dispatch_s = (
+                dt if self._ema_dispatch_s is None
+                else 0.8 * self._ema_dispatch_s + 0.2 * dt
+            )
+            member_traces = [
+                p.req.trace.trace_id for p in pendings if p.req.trace
+            ]
+            mesh_attr = (
+                {"mesh": self.engine.mesh_desc}
+                if self.engine.mesh_desc else {}
+            )
+            # retroactive: the dispatch ran on the pipeline workers, not here
+            self.tracer.span_event(
+                "sched.dispatch", t0, self._clock(), bucket=bucket,
+                n=len(pendings), pipelined=True, **mesh_attr,
+                **({"trace_ids": member_traces} if member_traces else {}),
+            )
+            self._settle(bucket, pendings, results)
+        finally:
+            # un-register only once fully settled (resolutions + terminal
+            # sched.resolve events emitted): close()'s drain treats an
+            # empty _inflight as "safe to tear the telemetry plane down"
+            with self._lock:
+                try:
+                    self._inflight.remove(dh)
+                except ValueError:
+                    pass
+                self._lock.notify_all()
+
+    def _settle(self, bucket: int, pendings: list, results: list) -> None:
+        """Post-dispatch tail shared by the sync and pipelined paths:
+        retry failures against a different executable, then resolve."""
+        reqs = [p.req for p in pendings]
         failed = [i for i, r in enumerate(results) if r.status == "error"]
         if failed and self.retry_failed:
             # retry once against a DIFFERENT executable: the next ladder

@@ -133,6 +133,7 @@ from alphafold2_tpu.observe import (
     Tracer,
 )
 from alphafold2_tpu.observe import exposition, flightrec
+from alphafold2_tpu.observe.tracing import device_idle_fraction
 
 # the tree's single cost_analysis()/MFU implementation (observe.flops):
 # bench, the serve engine, the train loop and bisect_perf all share it
@@ -612,6 +613,12 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
 
     owns_tracer = tracer is None
     tracer = tracer if tracer is not None else _tracer()
+    if not tracer.enabled:
+        # device_idle_frac is computed from live serve.dispatch /
+        # serve.device_get spans, so the headline run always traces (a
+        # memory-only tracer when no trace file was requested)
+        tracer = Tracer(enabled=True)
+        owns_tracer = True
     s = _serve_sizes()
     with _bench_stage(tracer, "serve:backend_init"):
         from alphafold2_tpu.parallel.sharding import parse_mesh_spec
@@ -684,6 +691,10 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
         wall = time.perf_counter() - t0
         executed_flops = engine.executed_flops - flops_before
     _PHASE["name"] = "serve:record"
+    # host/device overlap over the timed stream, measured from the spans
+    # the dispatch path just emitted (warmup compiles emit none of the
+    # device-span names, so the window covers exactly the stream)
+    idle = device_idle_fraction(tracer.events())
 
     total_residues = int(sum(len(r.seq) for r in reqs))
     assert all(r is not None for r in results)
@@ -721,6 +732,10 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
         # XLA build durations keyed by executable shape
         "compile_records": engine.compile_records,
         "device": jax.devices()[0].device_kind,
+        # dispatch-path variant key: pipelined ("depthN") vs serial
+        # ("off") numbers are different measurements — the regression
+        # gate refuses any cross-key comparison (observe.regress)
+        "pipeline": engine.pipeline_desc,
         # precision/kernel variant keys, present only when non-default so
         # pre-existing baselines stay comparable; the regression gate
         # refuses any cross-key comparison (observe.regress)
@@ -729,6 +744,16 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
         **({"kernels": engine.kernels_desc}
            if engine.kernels_desc != "auto" else {}),
     }
+    if idle is not None:
+        # fraction of the dispatch window the device spent NOT inside a
+        # serve.dispatch/serve.device_get span — the overlap the pipeline
+        # buys, gated as an absolute ceiling by observe/regress.py
+        record["device_idle_frac"] = round(idle["device_idle_frac"], 4)
+        record["device_idle"] = {
+            "busy_s": round(idle["busy_s"], 3),
+            "window_s": round(idle["window_s"], 3),
+            "dispatches": idle["dispatches"],
+        }
     if mesh is not None:
         # mesh-keyed record: the identity string keys the executable
         # cache, the result cache, the baseline file and the regression
@@ -801,9 +826,11 @@ def bench_serve(emit: bool = True, tracer: Tracer | None = None) -> dict:
             base.get("value")
             and base.get("metric") == record["metric"]
             and base.get("device") == record["device"]
-            # kernel policy is a variant key the metric label does not
-            # encode: a different selection is a different measurement
+            # kernel policy and dispatch-path pipelining are variant keys
+            # the metric label does not encode: a different selection is
+            # a different measurement
             and base.get("kernels") == record.get("kernels")
+            and base.get("pipeline") == record.get("pipeline")
         ):
             vs = record["value"] / base["value"]
             compared = True
@@ -1164,6 +1191,9 @@ def bench_serve_async(emit: bool = True, tracer: Tracer | None = None) -> dict:
         [r.trace_id for r in results
          if r.status != "rejected" and r.trace_id],
     )
+    # host/device overlap snapshot BEFORE the overhead probe below: the
+    # probe issues extra dispatches that would pollute the idle window
+    idle = device_idle_fraction(tracer.events())
 
     with _bench_stage(tracer, "serve_async:overhead_probe"):
         overhead = _telemetry_overhead_probe(engine, s)
@@ -1201,6 +1231,8 @@ def bench_serve_async(emit: bool = True, tracer: Tracer | None = None) -> dict:
         "histograms": hists,
         "compile_records": engine.compile_records,
         "device": jax.devices()[0].device_kind,
+        # dispatch-path variant key (see bench_serve): "depthN" or "off"
+        "pipeline": engine.pipeline_desc,
         "by_class": by_class,
         "trace": completeness,
         "trace_complete_fraction": completeness["fraction"],
@@ -1209,6 +1241,16 @@ def bench_serve_async(emit: bool = True, tracer: Tracer | None = None) -> dict:
         "telemetry_overhead": overhead,
         "telemetry_overhead_frac": overhead["overhead_frac"],
     }
+    if idle is not None:
+        # open-loop idleness is dominated by the arrival process, so its
+        # absolute ceiling (observe/regress.py) is far looser than the
+        # closed-loop serve bench's
+        record["device_idle_frac"] = round(idle["device_idle_frac"], 4)
+        record["device_idle"] = {
+            "busy_s": round(idle["busy_s"], 3),
+            "window_s": round(idle["window_s"], 3),
+            "dispatches": idle["dispatches"],
+        }
     # flat per-class keys beside the nested breakdown: the regression
     # gate's threshold table addresses record keys by name
     for cls, b in by_class.items():
@@ -1256,6 +1298,8 @@ def bench_serve_async(emit: bool = True, tracer: Tracer | None = None) -> dict:
             base.get("value")
             and base.get("metric") == record["metric"]
             and base.get("device") == record["device"]
+            # pipelined vs serial dispatch are different measurements
+            and base.get("pipeline") == record.get("pipeline")
         ):
             vs = record["value"] / base["value"]
             compared = True

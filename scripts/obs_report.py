@@ -38,7 +38,12 @@ from alphafold2_tpu.observe.tracectx import (
     reconstruct_traces,
     trace_incomplete_reason,
 )
-from alphafold2_tpu.observe.tracing import load_trace_events_lenient
+from alphafold2_tpu.observe.tracing import (
+    DEVICE_SPAN_NAMES,
+    device_idle_fraction,
+    load_trace_events_lenient,
+    merge_intervals,
+)
 
 
 def _fmt_s(seconds: float) -> str:
@@ -112,8 +117,89 @@ def report_trace(path: str) -> list:
             args = e.get("args", {})
             shape = ", ".join(f"{k}={v}" for k, v in sorted(args.items()))
             print(f"  {e['name']}({shape}): {_fmt_s(e.get('dur', 0) / 1e6)}")
+    report_pipeline(events)
     report_request_traces(events)
     return errors
+
+
+_HOST_SPAN_NAMES = ("serve.featurize", "serve.device_put")
+
+
+def report_pipeline(events: list, max_shown: int = 12) -> None:
+    """Pipelined-dispatch section (serve/pipeline.py): per-dispatch
+    host/device timeline keyed by the ``dispatch_index`` span arg, the
+    device-idle fraction over the dispatch window (the same
+    ``device_idle_frac`` bench records gate), each device phase's overlap
+    with OTHER dispatches' host work (the wall time double buffering
+    actually reclaimed), and the in-flight admission count
+    (``sched.inflight_admit`` instants from continuous batching)."""
+    per: dict = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = e.get("name")
+        if name not in _HOST_SPAN_NAMES and name not in DEVICE_SPAN_NAMES:
+            continue
+        args = e.get("args") or {}
+        if args.get("dispatch_index") is None:
+            continue
+        d = per.setdefault(
+            args["dispatch_index"],
+            {"host": [], "device": [], "bucket": args.get("bucket")},
+        )
+        iv = (e.get("ts", 0) / 1e6, (e.get("ts", 0) + e.get("dur", 0)) / 1e6)
+        d["host" if name in _HOST_SPAN_NAMES else "device"].append(iv)
+    per = {k: v for k, v in per.items() if v["device"]}
+    if not per:
+        return
+
+    idle = device_idle_fraction(events)
+    head = (f"device_idle_frac {idle['device_idle_frac']:.3f} over "
+            f"{_fmt_s(idle['window_s'])}") if idle else "no device window"
+    admits = sum(
+        1 for e in events if e.get("name") == "sched.inflight_admit"
+    )
+    pipelined = sum(
+        1 for e in events
+        if e.get("name") == "serve.batch"
+        and (e.get("args") or {}).get("pipelined")
+    )
+    print(f"-- pipelined dispatch ({len(per)} dispatches, {head}) --")
+    t0 = min(iv[0] for d in per.values() for iv in d["host"] + d["device"])
+    shown = 0
+    for idx in sorted(per):
+        if shown >= max_shown:
+            print(f"  ... {len(per) - max_shown} more dispatches")
+            break
+        shown += 1
+        d = per[idx]
+        host = merge_intervals(d["host"])
+        dev = merge_intervals(d["device"])
+        # device time of THIS dispatch that ran while ANOTHER dispatch's
+        # host stage was featurizing/transferring: the overlap the
+        # pipeline reclaimed vs a serial host->device->host loop
+        others = merge_intervals([
+            iv for j, o in per.items() if j != idx for iv in o["host"]
+        ])
+        overlap = 0.0
+        for ds, de in dev:
+            for hs, he in others:
+                overlap += max(0.0, min(de, he) - max(ds, hs))
+        line = f"  #{idx:<4} bucket {str(d['bucket'] or '?'):>5} "
+        if host:
+            line += (f" host {_fmt_s(sum(e - s for s, e in host)):>9}"
+                     f"@+{host[0][0] - t0:7.3f}s")
+        else:
+            line += f" host {'-':>9} {'':>9}"
+        line += (f"  device {_fmt_s(sum(e - s for s, e in dev)):>9}"
+                 f"@+{dev[0][0] - t0:7.3f}s")
+        if overlap:
+            line += f"  overlapped {_fmt_s(overlap)}"
+        print(line)
+    tail = f"  in-flight admissions: {admits}"
+    if pipelined:
+        tail += f"  (pipelined batches: {pipelined})"
+    print(tail)
 
 
 def report_request_traces(events: list, max_shown: int = 8) -> None:

@@ -375,6 +375,38 @@ def test_real_engine_fault_retry_success(engine):
     assert np.all(np.isfinite(r.atom14))
 
 
+@pytest.mark.parametrize("stage", ["transfer", "compute", "fetch"])
+def test_stage_fault_retried_success_per_stage(engine, stage):
+    """Satellite contract: a fault injected into each pipeline stage
+    (host device_put, executable call, result device_get) still yields
+    retried-success for the caller — the stage knob proves the error
+    routing works wherever the failure lands, not just pre-featurize."""
+    plan = FaultPlan(fail_bucket=8, times=1, fail_stage=stage)
+    eng = ServeEngine(_cfg(), params=engine.params, faults=plan)
+    with AsyncServeFrontend(eng) as fe:
+        r = fe.submit("ACDEFG").result(180)
+    assert r.ok and r.retried
+    assert r.bucket == 16  # retried on the next rung's executable
+    assert plan.fired == [{"dispatch": 1, "bucket": 8, "stage": stage}]
+    s = eng.stats()
+    assert s["serve.dispatch_errors"] == 1 and s["sched.retries"] == 1
+    assert np.all(np.isfinite(r.atom14))
+
+
+def test_fault_stage_spec_parsing_and_validation():
+    plan = FaultPlan.from_spec("bucket=8,times=1,stage=compute")
+    assert plan.fail_stage == "compute"
+    plan.on_dispatch(1, 8)  # staged plans are inert at the legacy hook
+    assert plan.fired == []
+    plan.on_stage("transfer", 1, 8)  # wrong stage: passes through
+    with pytest.raises(InjectedFault, match="at compute"):
+        plan.on_stage("compute", 1, 8)
+    assert plan.fired == [{"dispatch": 1, "bucket": 8, "stage": "compute"}]
+    plan.on_stage("compute", 2, 8)  # budget exhausted: inert
+    with pytest.raises(ValueError, match="fail_stage"):
+        FaultPlan(fail_bucket=8, fail_stage="nope")
+
+
 def test_threaded_frontend_end_to_end(engine):
     """Background-dispatcher smoke on the real engine: mixed lengths and
     duplicates all resolve ok through the live thread."""
