@@ -143,6 +143,18 @@ class ServeConfig:
     cache_size: int = 256  # (seq, seed)-keyed LRU result entries; 0 disables
     shed_watermark: float = 0.75  # queue fraction where low-priority sheds
     retry_failed: bool = True  # retry a failed dispatch on another executable
+    # --- variant-scan fast lane (serve/cache.py FeatureCache + affinity) ---
+    # featurized input trees kept in the content-addressed FeatureCache
+    # (leaf-interned LRU over derivation keys); 0 disables the layer
+    feature_cache_size: int = 128
+    # featurize a point mutant of a cached parent by patching only the
+    # columns its mutation touches (data.pipeline.featurize_delta) instead
+    # of recomputing the whole tree — byte-identical to cold featurization
+    delta_featurize: bool = True
+    # pack same-parent mutants (edit-distance-1 family, or an explicit
+    # ServeRequest.parent_id hint) into the same bucket formation so scan
+    # traffic rides full near-zero-padding batches
+    affinity_batching: bool = True
 
 
 @dataclass

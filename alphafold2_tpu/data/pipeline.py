@@ -46,9 +46,17 @@ def _smooth_walk(rng: np.random.Generator, n: int) -> np.ndarray:
     return (coords - coords.mean(0)).astype(np.float32)
 
 
-def _fill_msa(rng, seq_crop, msa_out, msa_mask_out, mutation_rate=0.15):
+def _fill_msa(rng, seq_crop, msa_out, msa_mask_out, mutation_rate=0.15,
+              mut_rows=None):
     """Fill (M, NM) MSA rows by mutating the cropped primary sequence —
-    the one MSA-synthesis implementation shared by every data source."""
+    the one MSA-synthesis implementation shared by every data source.
+
+    The rng stream consumed here depends only on (seed state, msa_len, M),
+    never on the sequence CONTENT: the mutation mask is drawn first and the
+    replacement residues are drawn for the masked positions regardless of
+    what they replace. ``featurize_delta`` builds on exactly that property.
+    ``mut_rows`` (a list) collects the per-row mutation masks when the
+    caller wants the delta-featurization plan."""
     M, NM = msa_out.shape
     msa_len = min(NM, len(seq_crop))
     for m in range(M):
@@ -57,6 +65,8 @@ def _fill_msa(rng, seq_crop, msa_out, msa_mask_out, mutation_rate=0.15):
         row[mut] = rng.integers(0, 20, size=int(mut.sum()))
         msa_out[m, :msa_len] = row
         msa_mask_out[m, :msa_len] = True
+        if mut_rows is not None:
+            mut_rows.append(mut)
 
 
 def _synthesize_backbone(rng: np.random.Generator, ca: np.ndarray) -> np.ndarray:
@@ -87,6 +97,29 @@ def featurize_bucketed(
     padded rows. Returns an UNBATCHED item dict (``seq`` (bucket,), ``mask``,
     ``msa``, ``msa_mask``) — the engine stacks items into its batch dim.
     """
+    item, _ = featurize_bucketed_with_plan(
+        seq_tokens, bucket_len, msa_depth, seed=seed, msa_len=msa_len
+    )
+    return item
+
+
+def featurize_bucketed_with_plan(
+    seq_tokens: np.ndarray,
+    bucket_len: int,
+    msa_depth: int,
+    seed: int = 0,
+    msa_len: int | None = None,
+) -> tuple:
+    """:func:`featurize_bucketed` plus the delta-featurization *plan*.
+
+    The plan records what :func:`featurize_delta` needs to featurize a
+    point mutant of this sequence without re-running the MSA synthesis:
+    the parent's tokens, the derivation coordinates (bucket/msa_depth/
+    seed), and the per-row mutation masks ``_fill_msa`` drew — at a given
+    (seed, length, msa_depth) those masks and the replacement residues are
+    sequence-content-independent, which is the whole trick. The item dict
+    is byte-identical to a plain ``featurize_bucketed`` call (same rng
+    consumption order)."""
     seq_tokens = np.asarray(seq_tokens, np.int32).reshape(-1)
     L = len(seq_tokens)
     if L > bucket_len:
@@ -103,8 +136,72 @@ def featurize_bucketed(
     }
     item["seq"][:L] = seq_tokens
     item["mask"][:L] = True
-    _fill_msa(rng, seq_tokens, item["msa"], item["msa_mask"])
-    return item
+    mut_rows: list = []
+    _fill_msa(rng, seq_tokens, item["msa"], item["msa_mask"],
+              mut_rows=mut_rows)
+    eff_len = min(NM, L)
+    plan = {
+        "tokens": seq_tokens.copy(),
+        "bucket_len": int(bucket_len),
+        "msa_depth": int(msa_depth),
+        "msa_len": int(NM),
+        "seed": int(seed),
+        # (M, min(NM, L)) bool: True where _fill_msa replaced the primary
+        # residue with a content-independent random one
+        "mut": (
+            np.stack(mut_rows) if mut_rows
+            else np.zeros((0, eff_len), bool)
+        ),
+    }
+    return item, plan
+
+
+def featurize_delta(
+    parent_item: dict,
+    plan: dict,
+    mutant_tokens: np.ndarray,
+) -> dict:
+    """Featurize a mutant of ``plan``'s parent by patching only the
+    touched columns — byte-identical to cold featurization.
+
+    For a mutant at the parent's length, the same (bucket, msa_depth,
+    seed) cold featurization differs from the parent's only at the mutated
+    positions: the primary-sequence slot, and per MSA row the positions
+    the row's mutation mask did NOT replace (masked positions hold random
+    residues whose draw never saw the sequence content). So the mutant's
+    feature tree is the parent's with those columns patched — an O(M ·
+    n_mutations) copy-and-patch instead of an O(M · L) re-synthesis. The
+    parity test (tests/test_variant_scan.py) pins byte-level equality
+    against :func:`featurize_bucketed`, tolerance zero.
+
+    Masks are returned as the PARENT'S arrays (they are content-independent
+    at equal length); callers must treat items as immutable, which the
+    serve engine does (stacking copies). Raises ValueError when the mutant
+    is not delta-eligible (different length)."""
+    mutant_tokens = np.asarray(mutant_tokens, np.int32).reshape(-1)
+    parent_tokens = plan["tokens"]
+    if len(mutant_tokens) != len(parent_tokens):
+        raise ValueError(
+            f"delta featurization needs equal lengths: mutant "
+            f"{len(mutant_tokens)} vs parent {len(parent_tokens)}"
+        )
+    positions = np.nonzero(mutant_tokens != parent_tokens)[0]
+    seq = parent_item["seq"].copy()
+    msa = parent_item["msa"].copy()
+    mut = plan["mut"]  # (M, eff_len) bool
+    eff_len = mut.shape[1] if mut.size else min(
+        plan["msa_len"], len(parent_tokens)
+    )
+    for p in positions:
+        seq[p] = mutant_tokens[p]
+        if p < eff_len:
+            msa[~mut[:, p], p] = mutant_tokens[p]
+    return {
+        "seq": seq,
+        "mask": parent_item["mask"],
+        "msa": msa,
+        "msa_mask": parent_item["msa_mask"],
+    }
 
 
 @dataclasses.dataclass

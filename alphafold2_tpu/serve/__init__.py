@@ -13,13 +13,22 @@ Configured by ``config.ServeConfig``; benched by ``bench.py --mode serve``
 """
 
 from alphafold2_tpu.serve.bucketing import (
+    FamilyTracker,
+    affinity_take,
     bucket_for,
     formation_ripe,
     geometric_ladder,
     padding_fraction,
+    point_mutation,
     validate_ladder,
 )
-from alphafold2_tpu.serve.cache import ResultCache, result_key
+from alphafold2_tpu.serve.cache import (
+    FeatureCache,
+    ResultCache,
+    feature_fingerprint,
+    feature_key,
+    result_key,
+)
 from alphafold2_tpu.serve.engine import ServeEngine, ServeRequest, ServeResult
 from alphafold2_tpu.serve.faults import FaultPlan, InjectedFault
 from alphafold2_tpu.serve.pipeline import (
@@ -32,7 +41,9 @@ from alphafold2_tpu.serve.scheduler import AsyncServeFrontend, PendingResult
 __all__ = [
     "AsyncServeFrontend",
     "DispatchHandle",
+    "FamilyTracker",
     "FaultPlan",
+    "FeatureCache",
     "InjectedFault",
     "PendingResult",
     "PipelineBatch",
@@ -41,10 +52,14 @@ __all__ = [
     "ServeEngine",
     "ServeRequest",
     "ServeResult",
+    "affinity_take",
     "bucket_for",
+    "feature_fingerprint",
+    "feature_key",
     "formation_ripe",
     "geometric_ladder",
     "padding_fraction",
+    "point_mutation",
     "result_key",
     "validate_ladder",
 ]

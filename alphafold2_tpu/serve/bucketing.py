@@ -12,7 +12,9 @@ refinement) runs masked so the padding cannot leak into valid coordinates.
 
 from __future__ import annotations
 
-from typing import Sequence
+import threading
+from collections import OrderedDict
+from typing import Optional, Sequence
 
 
 def validate_ladder(buckets: Sequence[int]) -> tuple:
@@ -86,3 +88,108 @@ def padding_fraction(lengths: Sequence[int], buckets: Sequence[int]) -> float:
         total += b
         padded += b - n
     return padded / total if total else 0.0
+
+
+# ------------------------------------------------ variant-scan affinity
+
+
+def point_mutation(seq: str, other: str) -> Optional[int]:
+    """Position of the single substitution separating two equal-length
+    sequences, or ``None`` when they are not point mutants of each other
+    (different lengths, identical, or >1 substitution). Early-exits at the
+    second mismatch, so scanning a window of non-relatives is cheap."""
+    if len(seq) != len(other):
+        return None
+    pos = -1
+    for i, (a, b) in enumerate(zip(seq, other)):
+        if a != b:
+            if pos >= 0:
+                return None
+            pos = i
+    return pos if pos >= 0 else None
+
+
+class FamilyTracker:
+    """Mutant-family detection over the arriving request stream.
+
+    A deep mutational scan is ~20·L point mutants of one parent; packing
+    them into the same batch formations (parent affinity) is what turns
+    near-duplicate traffic into near-zero-padding, maximally-reusing
+    batches. ``observe(seq, parent_id)`` assigns each request a family
+    label:
+
+    - an explicit ``ServeRequest.parent_id`` hint wins (``"hint:<id>"``) —
+      the client knows its scan better than any detector;
+    - otherwise the sequence is matched edit-distance-1 (substitutions
+      only; indels change length and bucket anyway) against a bounded
+      window of recently observed sequences, inheriting the match's label;
+    - an unmatched sequence starts a (so far singleton) family of its own
+      and ``observe`` returns ``None`` — regular traffic stays regular.
+
+    Thread-safe; the window is an LRU over sequences so a long-running
+    frontend's memory stays bounded."""
+
+    def __init__(self, window: int = 64):
+        self.window = max(1, int(window))
+        self._label: "OrderedDict[str, str]" = OrderedDict()  # seq -> label
+        self._lock = threading.Lock()
+
+    def observe(self, seq: str, parent_id: Optional[str] = None
+                ) -> Optional[str]:
+        with self._lock:
+            if parent_id:
+                label = f"hint:{parent_id}"
+                self._remember(seq, label)
+                return label
+            known = self._label.get(seq)
+            if known is not None:
+                self._label.move_to_end(seq)
+                # an exact repeat only counts as family traffic when its
+                # label names a real family (not its own singleton start)
+                return known if known != seq else None
+            for other in reversed(self._label):
+                if point_mutation(seq, other) is not None:
+                    label = self._label[other]
+                    self._remember(seq, label)
+                    return label
+            self._remember(seq, seq)
+            return None
+
+    def _remember(self, seq: str, label: str) -> None:
+        self._label[seq] = label
+        self._label.move_to_end(seq)
+        while len(self._label) > self.window:
+            self._label.popitem(last=False)
+
+
+def affinity_take(pendings: list, fill: int) -> list:
+    """Choose up to ``fill`` members for one batch formation, preferring
+    the head-of-queue request's family: same-family pendings deeper in the
+    queue jump ahead so a scan's mutants ride together (identical lengths
+    → near-zero padding, one executable). The head is always taken —
+    affinity reorders *within* a formation, it never delays the oldest
+    request — and leftover slots fall back to plain queue order, so mixed
+    traffic still fills the batch. Returns the chosen pendings; the caller
+    removes them from its queue by identity."""
+    if fill <= 0 or not pendings:
+        return []
+    head = pendings[0]
+    family = getattr(head, "family", None)
+    if family is None:
+        return pendings[:fill]
+    take = [head]
+    taken = {id(head)}
+    for p in pendings[1:]:
+        if len(take) >= fill:
+            break
+        if getattr(p, "family", None) == family:
+            take.append(p)
+            taken.add(id(p))
+    if len(take) < fill:
+        for p in pendings[1:]:
+            if len(take) >= fill:
+                break
+            if id(p) not in taken:
+                take.append(p)
+                taken.add(id(p))
+    return take

@@ -45,7 +45,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from alphafold2_tpu import constants
 from alphafold2_tpu.config import Config
-from alphafold2_tpu.data.pipeline import featurize_bucketed
+from alphafold2_tpu.data.pipeline import (
+    featurize_bucketed_with_plan,
+    featurize_delta,
+)
 from alphafold2_tpu.observe import (
     EventCounters,
     Histogram,
@@ -66,6 +69,7 @@ from alphafold2_tpu.parallel.sharding import (
 )
 from alphafold2_tpu.predict import encode_sequence
 from alphafold2_tpu.serve.bucketing import bucket_for, validate_ladder
+from alphafold2_tpu.serve.cache import FeatureCache, feature_key
 from alphafold2_tpu.train.end2end import End2EndModel
 
 
@@ -94,6 +98,12 @@ class ServeRequest:
     arrival_s: Optional[float] = None
     priority: int = 0
     deadline_s: Optional[float] = None
+    # variant-scan hint: requests carrying the same parent_id belong to one
+    # mutant family — the scheduler packs them into the same bucket
+    # formation (parent-affinity batching) without having to rediscover the
+    # family by edit distance. Optional: edit-distance-1 detection against
+    # recent traffic covers unhinted scans.
+    parent_id: Optional[str] = None
     trace: Optional[TraceContext] = dataclasses.field(
         default=None, compare=False, repr=False
     )
@@ -128,6 +138,11 @@ class ServeResult:
     cache_hit: bool = False  # served from the result cache / in-flight dedup
     retried: bool = False  # produced by the scheduler's retry dispatch
     trace_id: Optional[str] = None  # the owning request's trace identity
+    # featurization-reuse ledger entry: how this request's input tree was
+    # produced — "miss" (cold featurize), "hit" (FeatureCache), "delta"
+    # (column-patched from a cached parent). None on non-dispatched
+    # results (rejected / deadline / result-cache hits).
+    feat_reuse: Optional[str] = None
 
     @property
     def ok(self) -> bool:
@@ -315,6 +330,14 @@ class ServeEngine:
             raise ValueError(
                 f"serve.pipeline_depth must be >= 0, got {self.pipeline_depth}"
             )
+        # variant-scan fast lane: content-addressed featurization reuse.
+        # The FeatureCache holds featurized input trees keyed by their
+        # derivation (seq, bucket, msa_depth, seed) with leaves interned by
+        # content hash; delta featurization patches a point mutant's
+        # columns out of a cached parent instead of recomputing the tree.
+        fcap = int(cfg.serve.feature_cache_size)
+        self.feature_cache = FeatureCache(fcap) if fcap > 0 else None
+        self.delta_featurize = bool(cfg.serve.delta_featurize)
         self.pipeline = None
         if self.pipeline_depth > 0:
             from alphafold2_tpu.serve.pipeline import PipelinedDispatcher
@@ -604,15 +627,61 @@ class ServeEngine:
             batch += (-batch) % n_dp
         return batch
 
-    def _featurize_one(self, bucket: int, req: ServeRequest) -> dict:
+    # hamming-distance ceiling for the delta path: column patching is
+    # exact at ANY same-length edit count (each touched column is O(M)),
+    # but past a handful of edits the request is no longer "a mutant of"
+    # the parent in any traffic sense, so treat it as cold
+    DELTA_MAX_EDITS = 8
+
+    def _featurize_one(self, bucket: int, req: ServeRequest) -> tuple:
+        """Featurize one request, via the content-addressed fast lane when
+        possible. Returns ``(item, reuse)`` with ``reuse`` the per-request
+        ledger entry: ``"hit"`` (exact derivation-key cache hit),
+        ``"delta"`` (column-patched from a cached same-shape parent —
+        byte-identical to cold, pinned by tests), or ``"miss"`` (cold
+        featurize). Every dispatched request bumps exactly one of
+        ``serve.feat_hits`` / ``serve.feat_delta`` / ``serve.feat_misses``,
+        so the ledger always sums to the dispatched-request count."""
         tokens = encode_sequence(req.seq)[0]
-        item = featurize_bucketed(
-            tokens, bucket, self.msa_depth, seed=req.seed
-        )
         pad = bucket - len(req.seq)
         self.counters.bump("serve.padded_residues", pad)
         self.histograms["pad_ratio"].observe(pad / bucket)
-        return item
+        fc = self.feature_cache
+        if fc is None:
+            item, _ = featurize_bucketed_with_plan(
+                tokens, bucket, self.msa_depth, seed=req.seed
+            )
+            self.counters.bump("serve.feat_misses")
+            return item, "miss"
+        key = feature_key(req.seq, bucket, self.msa_depth, req.seed)
+        found = fc.lookup(key)
+        if found is not None:
+            self.counters.bump("serve.feat_hits")
+            return found[0], "hit"
+        if self.delta_featurize:
+            for p_item, p_plan in fc.delta_parent(
+                bucket, self.msa_depth, req.seed, len(req.seq)
+            ):
+                edits = int((p_plan["tokens"] != tokens).sum())
+                if 0 < edits <= self.DELTA_MAX_EDITS:
+                    item = featurize_delta(p_item, p_plan, tokens)
+                    # the mutant inherits the parent's plan verbatim apart
+                    # from its own tokens: the MSA mutation mask depends
+                    # only on (seed, msa_len, depth), never on sequence
+                    # content, so the mutant is itself a valid delta parent
+                    # (scan chains stay warm even after the original parent
+                    # ages out of the LRU)
+                    plan = dict(p_plan)
+                    plan["tokens"] = tokens.copy()
+                    item = fc.put(key, item, plan)
+                    self.counters.bump("serve.feat_delta")
+                    return item, "delta"
+        item, plan = featurize_bucketed_with_plan(
+            tokens, bucket, self.msa_depth, seed=req.seed
+        )
+        item = fc.put(key, item, plan)
+        self.counters.bump("serve.feat_misses")
+        return item, "miss"
 
     def _dummy_item(self, bucket: int) -> dict:
         """A fully-masked batch-padding slot."""
@@ -691,9 +760,12 @@ class ServeEngine:
                 )
 
     def _build_results(
-        self, bucket, reqs, waits, dispatch_s, refined, weights, disto
+        self, bucket, reqs, waits, dispatch_s, refined, weights, disto,
+        feat=None,
     ) -> list:
-        """Unpad/realize one batch's outputs into per-request results."""
+        """Unpad/realize one batch's outputs into per-request results.
+        ``feat`` (optional, slot-aligned) carries each request's
+        featurization-reuse ledger entry onto its result."""
         built = []
         for slot, req in enumerate(reqs):
             L = len(req.seq)
@@ -715,6 +787,7 @@ class ServeEngine:
                 queue_wait_s=wait,
                 dispatch_s=dispatch_s,
                 trace_id=req.trace.trace_id if req.trace else None,
+                feat_reuse=feat[slot] if feat is not None else None,
             ))
         return built
 
@@ -889,7 +962,11 @@ class ServeEngine:
                 "serve.featurize", bucket=bucket,
                 dispatch_index=dispatch_index,
             ):
-                items = [self._featurize_one(bucket, r) for r in chunk_reqs]
+                items, feat = [], []
+                for r in chunk_reqs:
+                    item, reuse = self._featurize_one(bucket, r)
+                    items.append(item)
+                    feat.append(reuse)
                 host = self._stack_host(bucket, items, batch)
                 stacked = self._transfer(host, dispatch_index, bucket)
 
@@ -931,7 +1008,7 @@ class ServeEngine:
             ):
                 built = self._build_results(
                     bucket, chunk_reqs, waits, dispatch_s,
-                    refined, weights, disto,
+                    refined, weights, disto, feat=feat,
                 )
             for idx, res in zip(chunk_idx, built):
                 results[idx] = res
@@ -973,7 +1050,8 @@ class ServeEngine:
             "serve.unpad", bucket=job.bucket, dispatch_index=job.index
         ):
             built = self._build_results(
-                job.bucket, reqs, waits, dispatch_s, refined, weights, disto
+                job.bucket, reqs, waits, dispatch_s, refined, weights,
+                disto, feat=job.feat,
             )
         member_traces = [r.trace.trace_id for r in reqs if r.trace]
         # the batch span is retroactive (its start predates this thread's

@@ -149,7 +149,7 @@ class _Job:
     __slots__ = (
         "bucket", "index", "arrival", "batch", "handle", "members",
         "n_real", "batch_size", "stacked", "compiled", "out", "fetched",
-        "error", "t_host0", "t_device0",
+        "error", "t_host0", "t_device0", "feat",
     )
 
     def __init__(self, bucket: int, index: int, arrival, batch, handle):
@@ -168,6 +168,7 @@ class _Job:
         self.error: Optional[BaseException] = None
         self.t_host0: Optional[float] = None
         self.t_device0: Optional[float] = None
+        self.feat: Optional[list] = None  # per-member featurize-reuse ledger
 
 
 class PipelinedDispatcher:
@@ -226,11 +227,14 @@ class PipelinedDispatcher:
                 dispatch_index=job.index,
             ):
                 items: list = []
+                job.feat = []
                 while True:  # drain members; joiners may land mid-loop
                     req = job.batch.next_member(len(items))
                     if req is None:
                         break  # nothing left unfeaturized: formation sealed
-                    items.append(eng._featurize_one(job.bucket, req))
+                    item, reuse = eng._featurize_one(job.bucket, req)
+                    items.append(item)
+                    job.feat.append(reuse)
             job.members = job.batch.members
             job.n_real = len(job.members)
             job.batch_size = eng._padded_batch(job.bucket, job.n_real)

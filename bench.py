@@ -1341,6 +1341,318 @@ def bench_serve_async(emit: bool = True, tracer: Tracer | None = None) -> dict:
     return record
 
 
+# ------------------------------------------------------------- serve-scan ---
+
+
+def _serve_scan_sizes() -> dict:
+    """The variant-scan flagship: one parent sequence plus its full
+    single-point deep-mutational-scan (19 substitutions x parent_len
+    positions ~= 20*L variants, every mutant distinct so the result cache
+    never short-circuits featurization accounting). CPU-mesh sized like
+    the other serve flagships; AF2TPU_SERVE_SCAN_* knobs rescale it and
+    mark the record non-flagship (never baseline-compared)."""
+    parent_len = _env_int("AF2TPU_SERVE_SCAN_PARENT_LEN", 24)
+    full_scan = parent_len * 19  # every (position, substitution) once
+    return {
+        "parent_len": parent_len,
+        "variants": _env_int("AF2TPU_SERVE_SCAN_VARIANTS", full_scan),
+        "max_batch": _env_int("AF2TPU_SERVE_SCAN_MAX_BATCH", 8),
+        # cold arm: this many variants dispatched one at a time through an
+        # identical engine with the fast lane off — the denominator of the
+        # amortized-speedup claim, same machine, same compile
+        "cold_sample": _env_int("AF2TPU_SERVE_SCAN_COLD_SAMPLE", 16),
+        "dim": _env_int("AF2TPU_SERVE_SCAN_DIM", 32),
+        "depth": _env_int("AF2TPU_SERVE_SCAN_DEPTH", 1),
+        "heads": _env_int("AF2TPU_SERVE_SCAN_HEADS", 2),
+        "dim_head": _env_int("AF2TPU_SERVE_SCAN_DIM_HEAD", 16),
+        "msa_depth": _env_int("AF2TPU_SERVE_SCAN_MSA_DEPTH", 2),
+        "mds_iters": _env_int("AF2TPU_SERVE_SCAN_MDS_ITERS", 20),
+        "dwell_ms": float(os.environ.get("AF2TPU_SERVE_SCAN_DWELL_MS", 10.0)),
+        "seed": _env_int("AF2TPU_SERVE_SCAN_SEED", 0),
+    }
+
+
+def scan_config_overridden() -> bool:
+    return any(k.startswith("AF2TPU_SERVE_SCAN_") for k in os.environ)
+
+
+def _serve_scan_metric(s: dict) -> str:
+    return (
+        f"serve-scan variants/sec parent_len={s['parent_len']} "
+        f"variants={s['variants']} max_batch={s['max_batch']} "
+        f"cold_sample={s['cold_sample']} dim={s['dim']} depth={s['depth']} "
+        f"msa_depth={s['msa_depth']} mds_iters={s['mds_iters']} "
+        f"dwell_ms={s['dwell_ms']:g}"
+    )
+
+
+def _scan_mutants(parent: str, n: int, rng) -> list:
+    """``n`` DISTINCT single-point mutants of ``parent`` in a seeded
+    shuffled order — a deep mutational scan submits position-sweeps, but
+    shuffling makes the affinity former's job honest (siblings are found
+    by family, not by accidental adjacency)."""
+    alpha = "ACDEFGHIKLMNPQRSTVWY"
+    all_muts = [
+        parent[:i] + aa + parent[i + 1:]
+        for i in range(len(parent))
+        for aa in alpha
+        if aa != parent[i]
+    ]
+    rng.shuffle(all_muts)
+    return all_muts[:n]
+
+
+def bench_serve_scan(emit: bool = True, tracer: Tracer | None = None) -> dict:
+    """Variant-scan fast-lane bench: amortized per-variant latency of a
+    deep mutational scan through the scan lane vs the cold path.
+
+    Two arms on the same machine in one process:
+
+    - **scan lane** — parent + ``variants`` distinct point mutants (one
+      seed: delta featurization requires seed equality) submitted as a
+      burst to an ``AsyncServeFrontend`` with the content-addressed
+      FeatureCache, delta featurization and parent-affinity batching on.
+      Amortized per-variant latency = wall / requests.
+    - **cold path** — ``cold_sample`` of the same variants dispatched ONE
+      AT A TIME through an identical engine with the fast lane disabled:
+      each pays featurization, batch padding and a whole dispatch alone,
+      which is exactly what today's cache-miss mutant traffic pays.
+
+    The record's ``speedup_vs_cold`` (cold per-variant / scan per-variant)
+    is the tentpole's >=5x acceptance bar, gated absolutely in
+    observe/regress.py SERVE_SCAN_THRESHOLDS. The featurization-reuse
+    ledger must fully account the scan arm: ``feat_hits + feat_misses +
+    feat_delta == requests`` (every dispatched request bumps exactly one),
+    recorded as ``ledger_accounted_frac``. The record carries
+    ``"scan": true`` — a comparability variant key, so scan records never
+    ratio against plain serve records."""
+    import numpy as np
+
+    from alphafold2_tpu.config import (
+        Config, DataConfig, ModelConfig, ServeConfig,
+    )
+    from alphafold2_tpu.observe import Histogram
+    from alphafold2_tpu.serve import (
+        AsyncServeFrontend, ServeEngine, ServeRequest,
+    )
+
+    owns_tracer = tracer is None
+    tracer = tracer if tracer is not None else _tracer()
+    s = _serve_scan_sizes()
+    bucket = s["parent_len"]  # one rung: a scan is single-length traffic
+    rng = np.random.default_rng(s["seed"])
+    alpha = "ACDEFGHIKLMNPQRSTVWY"
+    parent = "".join(rng.choice(list(alpha), size=s["parent_len"]))
+    mutants = _scan_mutants(parent, s["variants"], rng)
+    n_requests = 1 + len(mutants)  # parent + mutants
+
+    def _cfg(fast_lane: bool) -> Config:
+        return Config(
+            model=ModelConfig(
+                dim=s["dim"], depth=s["depth"], heads=s["heads"],
+                dim_head=s["dim_head"], max_seq_len=3 * bucket,
+                bfloat16=jax.devices()[0].platform != "cpu",
+            ),
+            data=DataConfig(msa_depth=s["msa_depth"]),
+            serve=ServeConfig(
+                buckets=(bucket,), max_batch=s["max_batch"],
+                mds_iters=s["mds_iters"], dwell_ms=s["dwell_ms"],
+                # the whole scan queues as one burst: deep queue, no
+                # shedding (0 disables the watermark), no deadline —
+                # admission control is not what this bench measures
+                queue_depth=n_requests + 64,
+                shed_watermark=0.0,
+                default_deadline_s=0.0,
+                feature_cache_size=(n_requests + 16) if fast_lane else 0,
+                delta_featurize=fast_lane,
+                affinity_batching=fast_lane,
+            ),
+        )
+
+    with _bench_stage(tracer, "serve_scan:backend_init"):
+        engine = ServeEngine(_cfg(fast_lane=True), tracer=tracer)
+    with _bench_stage(tracer, "serve_scan:trace_compile"):
+        t0 = time.perf_counter()
+        engine.warmup()  # compiles only; featurizes nothing (clean ledger)
+        compile_s = time.perf_counter() - t0
+
+    # ---- scan-lane arm: the whole scan as one burst ----
+    frontend = AsyncServeFrontend(engine, tracer=tracer)
+    with _bench_stage(tracer, "serve_scan:timed_scan"):
+        t0 = time.perf_counter()
+        handles = [frontend.submit(ServeRequest(parent, seed=s["seed"]))]
+        handles += [
+            frontend.submit(ServeRequest(
+                m, seed=s["seed"], parent_id="scan-parent-0"
+            ))
+            for m in mutants
+        ]
+        results = [h.result(timeout=600) for h in handles]
+        scan_wall = time.perf_counter() - t0
+    frontend.close()
+    stats = engine.counters.snapshot()
+    ok = [r for r in results if r.status == "ok"]
+    lat = Histogram()
+    for r in ok:
+        lat.observe(r.latency_s)
+    lat_ms = lat.snapshot(unit_scale=1e3, digits=4) if ok else {"count": 0}
+
+    # featurization-reuse ledger: every dispatched request bumped exactly
+    # one of the three counters, and every result carries its entry
+    feat_hits = stats.get("serve.feat_hits", 0)
+    feat_misses = stats.get("serve.feat_misses", 0)
+    feat_delta = stats.get("serve.feat_delta", 0)
+    featurized = feat_hits + feat_misses + feat_delta
+    by_reuse: dict = {}
+    for r in results:
+        by_reuse[r.feat_reuse] = by_reuse.get(r.feat_reuse, 0) + 1
+    ledger = {
+        "feat_hits": feat_hits,
+        "feat_misses": feat_misses,
+        "feat_delta": feat_delta,
+        "featurized": featurized,
+        "requests": n_requests,
+        "results_by_reuse": {str(k): v for k, v in by_reuse.items()},
+    }
+
+    # ---- cold arm: one variant per dispatch, fast lane off ----
+    with _bench_stage(tracer, "serve_scan:cold_arm"):
+        cold_engine = ServeEngine(
+            _cfg(fast_lane=False), params=engine.params, tracer=tracer
+        )
+        cold_engine.warmup()
+        sample = mutants[: max(1, s["cold_sample"])]
+        t0 = time.perf_counter()
+        for m in sample:
+            cold_engine.predict_many([ServeRequest(m, seed=s["seed"])])
+        cold_wall = time.perf_counter() - t0
+        cold_engine.close()
+    _PHASE["name"] = "serve_scan:record"
+
+    scan_per_variant = scan_wall / max(1, len(ok))
+    cold_per_variant = cold_wall / len(sample)
+    speedup = (
+        cold_per_variant / scan_per_variant if scan_per_variant > 0 else 0.0
+    )
+    fc_stats = (
+        engine.feature_cache.stats()
+        if engine.feature_cache is not None else {}
+    )
+    engine.close()
+    hists = {
+        (n[:-2] + "_ms" if n.endswith("_s") else n): snap
+        for n, snap in {
+            **engine.histogram_snapshots(unit_scale=1e3),
+            **frontend.histogram_snapshots(unit_scale=1e3),
+        }.items()
+    }
+    hists["latency_e2e_ms"] = lat_ms
+    # flat padding-fraction scalars beside the nested histograms: the
+    # obs_report variant-scan section reads metrics.jsonl, which only
+    # carries scalars
+    pad_flat = {}
+    for hname, key in (("affinity_pad_fraction", "affinity_pad_p50"),
+                       ("regular_pad_fraction", "regular_pad_p50")):
+        snap = hists.get(hname) or {}
+        if snap.get("count"):
+            pad_flat[key] = round(snap.get("p50", 0.0), 4)
+
+    record = {
+        "metric": _serve_scan_metric(s),
+        "value": round(len(ok) / scan_wall, 1) if scan_wall > 0 else 0.0,
+        "unit": "variants/sec",
+        "mode": "serve-scan",
+        # comparability variant key: scan records only ever ratio against
+        # scan records (observe/regress.py comparable_reason)
+        "scan": True,
+        "speedup_vs_cold": round(speedup, 2),
+        "scan_ms_per_variant": round(scan_per_variant * 1e3, 2),
+        "cold_ms_per_variant": round(cold_per_variant * 1e3, 2),
+        "cold_sampled": len(sample),
+        "reuse_ledger": ledger,
+        "ledger_accounted_frac": (
+            round(featurized / n_requests, 4) if n_requests else 0.0
+        ),
+        "reuse_fraction": (
+            round((feat_hits + feat_delta) / featurized, 4)
+            if featurized else 0.0
+        ),
+        "feature_cache": fc_stats,
+        "p50_ms": round(lat_ms.get("p50", 0.0), 1),
+        "p95_ms": round(lat_ms.get("p95", 0.0), 1),
+        "requests": n_requests,
+        "completed": len(ok),
+        "affinity_batches": stats.get("sched.affinity_batches", 0),
+        "family_members": stats.get("sched.family_members", 0),
+        "family_inflight_joins": stats.get(
+            "sched.family_inflight_joins", 0
+        ),
+        "inflight_admitted": stats.get("sched.inflight_admitted", 0),
+        "dispatches": stats.get("sched.dispatches", 0),
+        "compiles": stats.get("serve.compiles", 0),
+        "compile_s": round(compile_s, 1),
+        "histograms": hists,
+        **pad_flat,
+        "device": jax.devices()[0].device_kind,
+        "pipeline": engine.pipeline_desc,
+    }
+    if _CLOCK["probe"] is not None:
+        record["clock_probe"] = _CLOCK["probe"]
+        if not _CLOCK["probe"]["ok"]:
+            record["clock_suspect"] = True
+
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "bench_serve_scan_baseline.json",
+    )
+    vs, compared = 1.0, False
+    if (
+        os.path.exists(baseline_path)
+        and not scan_config_overridden()
+        and not record.get("clock_suspect")
+    ):
+        with open(baseline_path) as f:
+            base = json.load(f)
+        if (
+            base.get("value")
+            and base.get("metric") == record["metric"]
+            and base.get("device") == record["device"]
+            and base.get("pipeline") == record.get("pipeline")
+            and bool(base.get("scan")) == bool(record.get("scan"))
+        ):
+            vs = record["value"] / base["value"]
+            compared = True
+    record["vs_baseline"] = round(vs, 3)
+    record["vs_baseline_valid"] = compared and not record.get("clock_suspect")
+    if record.get("clock_suspect"):
+        record["vs_baseline"] = 0.0
+
+    if (
+        os.environ.get("AF2TPU_SERVE_RECORD_BASELINE") == "1"
+        and not scan_config_overridden()
+        and not record.get("clock_suspect")
+    ):
+        with open(baseline_path, "w") as f:
+            json.dump(record, f, indent=2)
+        print(
+            f"recorded serve-scan baseline -> {baseline_path}",
+            file=sys.stderr,
+        )
+
+    logger = _metrics_logger()
+    if logger is not None:
+        logger.log(0, stats)
+        logger.log(0, {
+            k: v for k, v in record.items()
+            if isinstance(v, (int, float, str, bool))
+        })
+    if owns_tracer:
+        tracer.close()
+    if emit:
+        _emit(record)
+    return record
+
+
 # ---------------------------------------------------------------- kernels ---
 
 
@@ -1543,8 +1855,9 @@ def bench_kernels(emit: bool = True, tracer: Tracer | None = None) -> dict:
 
 def bench_mode(argv=None) -> str:
     """The bench mode: 'train' (default flagship step bench), 'serve'
-    (closed-loop batched engine), 'serve-async' (open-loop frontend) or
-    'kernels' (fused-vs-stock attention microbench).
+    (closed-loop batched engine), 'serve-async' (open-loop frontend),
+    'serve-scan' (variant-scan fast lane vs cold path) or 'kernels'
+    (fused-vs-stock attention microbench).
     Spelled ``--mode serve`` / ``--mode=serve-async`` or AF2TPU_BENCH_MODE."""
     args = sys.argv[1:] if argv is None else argv
     for i, a in enumerate(args):
@@ -1761,7 +2074,7 @@ if __name__ == "__main__":
         ).start()
 
     _mode = bench_mode()
-    if _mode in ("serve", "serve-async", "kernels"):
+    if _mode in ("serve", "serve-async", "serve-scan", "kernels"):
         # the serve/kernels benches run wherever the engine runs (the CPU
         # mesh included — that is the point: valid perf numbers without the
         # tunnel); no preflight, no first-light, same watchdog + one-JSON-
@@ -1770,6 +2083,7 @@ if __name__ == "__main__":
             {
                 "serve": bench_serve,
                 "serve-async": bench_serve_async,
+                "serve-scan": bench_serve_scan,
                 "kernels": bench_kernels,
             }[_mode]()
             sys.exit(0)

@@ -360,6 +360,58 @@ def report_scheduler(latest: dict) -> None:
               f"p95 {latest['p95_ms']:.1f}ms  p99 {latest['p99_ms']:.1f}ms")
 
 
+def report_variant_scan(latest: dict) -> None:
+    """Variant-scan fast-lane section: printed when the featurization
+    ledger counters (``serve.feat_*``) or a ``--mode serve-scan`` bench
+    record rode the file. Shows the featurize-reuse ratio (hit/delta/miss
+    accounting), mutant-family sizes from the affinity former, and the
+    padding fraction of affinity-formed vs regular batch formations."""
+    hits = latest.get("serve.feat_hits", 0)
+    misses = latest.get("serve.feat_misses", 0)
+    delta = latest.get("serve.feat_delta", 0)
+    featurized = hits + misses + delta
+    is_scan = latest.get("mode") == "serve-scan" or latest.get("scan")
+    if not featurized and not is_scan:
+        return
+    print("-- variant scan --")
+    if featurized:
+        reuse = (hits + delta) / featurized
+        print(f"  featurize reuse: {reuse:.1%} of {int(featurized)} "
+              f"featurized requests "
+              f"({int(hits)} cache hits + {int(delta)} delta-patched "
+              f"mutants; {int(misses)} cold)")
+    members = latest.get("sched.family_members", 0)
+    batches = latest.get("sched.affinity_batches", 0)
+    joins = latest.get("sched.family_inflight_joins", 0)
+    if members:
+        size = f"  (mean {members / batches:.1f} per batch)" if batches \
+            else ""
+        print(f"  families:        {int(members)} family members over "
+              f"{int(batches)} affinity-formed batches{size}")
+    if joins:
+        print(f"  late siblings:   {int(joins)} joined their family's "
+              f"in-flight batch")
+    aff = latest.get("affinity_pad_p50")
+    reg = latest.get("regular_pad_p50")
+    if aff is not None or reg is not None:
+        parts = []
+        if aff is not None:
+            parts.append(f"affinity-formed p50 {aff:.1%}")
+        if reg is not None:
+            parts.append(f"regular p50 {reg:.1%}")
+        print(f"  padding:         {'  vs  '.join(parts)}")
+    if latest.get("speedup_vs_cold") is not None:
+        print(f"  amortized:       {latest['speedup_vs_cold']}x vs the "
+              f"cold path "
+              f"({latest.get('scan_ms_per_variant')}ms/variant scanned, "
+              f"{latest.get('cold_ms_per_variant')}ms/variant cold)")
+    if latest.get("ledger_accounted_frac") is not None:
+        frac = latest["ledger_accounted_frac"]
+        ok = "fully accounted" if frac >= 1.0 else "UNACCOUNTED"
+        print(f"  ledger:          {frac:.1%} of requests accounted "
+              f"({ok})")
+
+
 def report_kernels(latest: dict) -> None:
     """Kernels/precision section: printed when records carry the kernel-
     policy or serving-dtype keys (ops/kernels.py KernelPolicy, serve.dtype)
@@ -555,6 +607,7 @@ def report_metrics(path: str) -> list:
 
     report_train(records)
     report_scheduler(latest)
+    report_variant_scan(latest)
     report_slo(latest)
     report_mesh(latest)
     report_kernels(latest)

@@ -284,6 +284,31 @@ def test_result_cache_lru_eviction_and_inflight_table():
     assert nocache.lookup_or_claim("x")[0] == "leader"
 
 
+def test_lru_eviction_with_attached_follower_never_orphans():
+    # the in-flight table is separate from the LRU: churning the LRU to
+    # capacity while a leader is still queued with a follower attached
+    # must not detach the follower — when the leader finally dispatches,
+    # both handles resolve with the same arrays
+    fe, eng, clock = _frontend(cache_size=1, dwell_ms=10_000.0)
+    h1 = fe.submit(ServeRequest("ACDEFG", seed=7))  # leader, stays queued
+    h2 = fe.submit(ServeRequest("ACDEFG", seed=7))  # follower attached
+    assert fe.pump() == 0  # bucket 8 under-full, dwell huge: in-flight
+    # two bucket-16 requests fill and complete: with capacity 1 the second
+    # completion EVICTS the first — LRU churn while the follower waits
+    fe.submit("ACDEFGHKLMNP")
+    fe.submit("WWWWWWWWWWWW")
+    assert fe.pump() == 1
+    st = fe.cache.stats()
+    assert st["entries"] == 1 and st["inflight"] == 1
+    clock.advance(10.1)
+    assert fe.pump() == 1  # leader's dwell expires: dispatch
+    r1, r2 = h1.result(0), h2.result(0)
+    assert r1.ok and r2.ok
+    assert r2.cache_hit and r2.atom14 is r1.atom14  # follower resolved
+    assert fe.stats()["sched.inflight_dedup"] == 1
+    assert fe.cache.stats()["inflight"] == 0  # nothing left dangling
+
+
 # ------------------------------------------------------------ fault + retry
 
 

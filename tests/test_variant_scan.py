@@ -1,0 +1,439 @@
+"""Variant-scan fast lane tests (serve/cache.py FeatureCache +
+serve/bucketing.py family detection/affinity + data/pipeline.py delta
+featurization + engine ledger).
+
+The load-bearing contract is byte-level parity: a delta-featurized point
+mutant (column patching against a cached parent) must be bit-identical to
+cold featurization — tolerance zero, pinned via ``tobytes()``. On top of
+that: the content-addressed FeatureCache's interning/eviction/refcount
+behavior, mutant-family detection (explicit ``parent_id`` hint and
+edit-distance-1 discovery), affinity batch formation (same-family requests
+jump ahead, the head is never delayed), and the end-to-end featurize-reuse
+ledger on a real engine (``hits + misses + delta == dispatched requests``,
+with every ``ServeResult`` stamped with its reuse class)."""
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    ServeConfig,
+)
+from alphafold2_tpu.data.pipeline import (
+    featurize_bucketed,
+    featurize_bucketed_with_plan,
+    featurize_delta,
+)
+from alphafold2_tpu.observe import EventCounters, Tracer
+from alphafold2_tpu.predict import encode_sequence
+from alphafold2_tpu.serve import (
+    AsyncServeFrontend,
+    FamilyTracker,
+    FeatureCache,
+    ServeEngine,
+    ServeRequest,
+    ServeResult,
+    affinity_take,
+    feature_fingerprint,
+    feature_key,
+    point_mutation,
+)
+
+
+def _tokens(seq):
+    return encode_sequence(seq)[0]
+
+
+def _mutate(seq, pos, to="W"):
+    aa = to if seq[pos] != to else "Y"
+    return seq[:pos] + aa + seq[pos + 1:]
+
+
+# ------------------------------------------------- delta featurization parity
+
+
+def test_featurize_with_plan_matches_plain():
+    tokens = _tokens("ACDEFGHIKLMN")
+    plain = featurize_bucketed(tokens, 16, 4, seed=3)
+    item, plan = featurize_bucketed_with_plan(tokens, 16, 4, seed=3)
+    assert sorted(item) == sorted(plain)
+    for name in plain:
+        assert item[name].tobytes() == plain[name].tobytes()
+        assert item[name].dtype == plain[name].dtype
+    assert plan["bucket_len"] == 16 and plan["msa_depth"] == 4
+    assert plan["seed"] == 3 and np.array_equal(plan["tokens"], tokens)
+
+
+@pytest.mark.parametrize("positions", [(0,), (5,), (11,), (0, 11), (2, 5, 9)])
+def test_delta_featurization_byte_parity(positions):
+    parent = "ACDEFGHIKLMN"  # 12 residues in a 16 bucket
+    p_item, plan = featurize_bucketed_with_plan(
+        _tokens(parent), 16, 4, seed=5
+    )
+    mutant = parent
+    for p in positions:
+        mutant = _mutate(mutant, p)
+    mut_tokens = _tokens(mutant)
+    delta = featurize_delta(p_item, plan, mut_tokens)
+    cold = featurize_bucketed(mut_tokens, 16, 4, seed=5)
+    assert sorted(delta) == sorted(cold)
+    for name in cold:  # tolerance ZERO: the fast lane may not drift a bit
+        assert delta[name].tobytes() == cold[name].tobytes(), name
+        assert delta[name].shape == cold[name].shape
+        assert delta[name].dtype == cold[name].dtype
+
+
+def test_delta_parity_with_short_msa_rows():
+    # msa_len < L: a mutation past the MSA's effective length touches only
+    # the primary sequence, and the column patch must not index past it
+    parent = "ACDEFGHIKLMN"
+    p_item, plan = featurize_bucketed_with_plan(
+        _tokens(parent), 16, 3, seed=9, msa_len=8
+    )
+    for pos in (3, 10):  # one inside the MSA window, one beyond it
+        mutant = _mutate(parent, pos)
+        delta = featurize_delta(p_item, plan, _tokens(mutant))
+        cold = featurize_bucketed(_tokens(mutant), 16, 3, seed=9, msa_len=8)
+        for name in cold:
+            assert delta[name].tobytes() == cold[name].tobytes(), name
+
+
+def test_delta_chains_through_a_mutant():
+    # a delta-featurized mutant inherits the parent's plan (with its own
+    # tokens) and must itself be a byte-exact delta parent — scan chains
+    # survive the original parent aging out of the cache
+    parent = "MKVLITHDSAGE"
+    p_item, p_plan = featurize_bucketed_with_plan(
+        _tokens(parent), 16, 4, seed=2
+    )
+    m1 = _mutate(parent, 4)
+    m1_item = featurize_delta(p_item, p_plan, _tokens(m1))
+    m1_plan = dict(p_plan)
+    m1_plan["tokens"] = _tokens(m1)
+    m2 = _mutate(m1, 9)
+    via_chain = featurize_delta(m1_item, m1_plan, _tokens(m2))
+    cold = featurize_bucketed(_tokens(m2), 16, 4, seed=2)
+    for name in cold:
+        assert via_chain[name].tobytes() == cold[name].tobytes(), name
+
+
+def test_delta_rejects_length_mismatch():
+    p_item, plan = featurize_bucketed_with_plan(_tokens("ACDEFG"), 8, 2)
+    with pytest.raises(ValueError, match="equal lengths"):
+        featurize_delta(p_item, plan, _tokens("ACDEFGH"))
+
+
+# ---------------------------------------------------------------- FeatureCache
+
+
+def _leafy(seed, L=4, shared_seq=None):
+    """A small featurized-tree stand-in; ``shared_seq`` lets two items
+    carry byte-identical seq/mask leaves (the cross-seed intern case)."""
+    rng = np.random.default_rng(seed)
+    seq = (shared_seq if shared_seq is not None
+           else rng.integers(0, 20, L).astype(np.int32))
+    return {
+        "seq": np.array(seq, np.int32),
+        "mask": np.ones(L, bool),
+        "msa": rng.integers(0, 20, (2, L)).astype(np.int32),
+    }
+
+
+def test_feature_key_ignores_request_metadata():
+    # priority/deadline/parent_id/trace never reach the key: requests
+    # differing only in metadata share the featurized entry
+    assert feature_key("ACDEFG", 8, 2, 0) == ("ACDEFG", 8, 2, 0)
+
+
+def test_feature_fingerprint_is_content_addressed():
+    a, b = _leafy(1), _leafy(1)
+    assert a["seq"] is not b["seq"]
+    assert feature_fingerprint(a) == feature_fingerprint(b)
+    c = _leafy(2)
+    assert feature_fingerprint(a) != feature_fingerprint(c)
+
+
+def test_feature_cache_roundtrip_freeze_and_interning():
+    fc = FeatureCache(8)
+    k1 = feature_key("AAAA", 8, 2, 0)
+    k2 = feature_key("AAAA", 8, 2, 1)  # different seed, same seq/mask bytes
+    shared = np.arange(4, dtype=np.int32)
+    i1 = fc.put(k1, _leafy(10, shared_seq=shared), plan={"tokens": shared})
+    assert fc.lookup(feature_key("CCCC", 8, 2, 0)) is None  # miss counted
+    found = fc.lookup(k1)
+    assert found is not None and found[0]["seq"] is i1["seq"]
+    i2 = fc.put(k2, _leafy(11, shared_seq=shared))
+    # seed-independent leaves intern to ONE array across seeds
+    assert i2["seq"] is i1["seq"]
+    stats = fc.stats()
+    assert stats["leaf_dedup_hits"] >= 1
+    assert stats["unique_leaves"] < 6  # 2 entries x 3 leaves, seq+mask shared
+    assert stats["hits"] == 1 and stats["misses"] == 1
+    # cached arrays are frozen: an in-place edit fails loudly
+    with pytest.raises(ValueError):
+        i1["seq"][0] = 99
+
+
+def test_feature_cache_first_put_wins_on_race():
+    fc = FeatureCache(4)
+    k = feature_key("ACDE", 8, 2, 0)
+    first = fc.put(k, _leafy(1))
+    second = fc.put(k, _leafy(1))  # racing featurizer: same content
+    assert second["seq"] is first["seq"]
+    assert len(fc) == 1
+
+
+def test_feature_cache_eviction_decrefs_interned_leaves():
+    fc = FeatureCache(1)
+    fc.put(feature_key("AAAA", 8, 2, 0), _leafy(1), plan={"p": 1})
+    assert fc.stats()["unique_leaves"] == 3
+    fc.put(feature_key("CCCC", 8, 2, 0), _leafy(2), plan={"p": 2})
+    assert len(fc) == 1
+    # the evicted entry's leaves were decref'd away, not leaked
+    assert fc.stats()["unique_leaves"] == 3
+    assert fc.lookup(feature_key("AAAA", 8, 2, 0)) is None
+    # the shape index followed the eviction: only the survivor remains
+    parents = fc.delta_parent(8, 2, 0, 4)
+    assert [p[1]["p"] for p in parents] == [2]
+
+
+def test_feature_cache_delta_parent_window():
+    fc = FeatureCache(64)
+    n = FeatureCache.DELTA_SCAN + 3
+    for i in range(n):
+        fc.put(feature_key(f"SEQ{i:04d}", 8, 2, 0), _leafy(i),
+               plan={"i": i} if i % 2 == 0 else None)
+    parents = fc.delta_parent(8, 2, 0, 7)
+    # bounded scan, most recent first, plan-carrying entries only
+    assert len(parents) <= FeatureCache.DELTA_SCAN
+    idx = [p[1]["i"] for p in parents]
+    assert idx == sorted(idx, reverse=True)
+    assert fc.delta_parent(16, 2, 0, 7) == []  # other shapes unseen
+
+
+def test_feature_cache_capacity_zero_is_passthrough():
+    fc = FeatureCache(0)
+    item = _leafy(1)
+    assert fc.put(feature_key("AAAA", 8, 2, 0), item) is item
+    assert len(fc) == 0
+    assert fc.lookup(feature_key("AAAA", 8, 2, 0)) is None
+
+
+# ----------------------------------------------- family detection + affinity
+
+
+def test_point_mutation_detection():
+    assert point_mutation("ACDEFG", "ACDEFW") == 5
+    assert point_mutation("WCDEFG", "ACDEFG") == 0
+    assert point_mutation("ACDEFG", "ACDEFG") is None  # identical
+    assert point_mutation("ACDEFG", "ACDEF") is None  # length mismatch
+    assert point_mutation("ACDEFG", "WCDEFW") is None  # two substitutions
+
+
+def test_family_tracker_hint_wins():
+    t = FamilyTracker()
+    assert t.observe("AAAAAA", parent_id="scan7") == "hint:scan7"
+    assert t.observe("AAAAAC", parent_id="scan7") == "hint:scan7"
+
+
+def test_family_tracker_edit_distance_discovery():
+    t = FamilyTracker()
+    assert t.observe("ACDEFG") is None  # unmatched: singleton start
+    assert t.observe("ACDEFG") is None  # exact repeat of a singleton
+    label = t.observe("ACDEFW")  # point mutant: inherits the family
+    assert label == "ACDEFG"
+    assert t.observe("ACDEFY") == "ACDEFG"  # sibling joins the same family
+    assert t.observe("ACDEFW") == "ACDEFG"  # exact repeat of a member
+    assert t.observe("MKVLIT") is None  # stranger stays regular traffic
+
+
+def test_family_tracker_window_is_bounded():
+    t = FamilyTracker(window=2)
+    t.observe("ACDEFG")
+    t.observe("MKVLIT")
+    t.observe("WWWWWW")  # pushes ACDEFG out of the window
+    assert t.observe("ACDEFW") is None  # parent forgotten: new singleton
+
+
+class _P:
+    def __init__(self, name, family=None):
+        self.name = name
+        self.family = family
+
+
+def test_affinity_take_packs_family_and_backfills():
+    q = [_P("f1", "fam"), _P("s1"), _P("s2"), _P("f2", "fam"),
+         _P("f3", "fam")]
+    take = affinity_take(q, 3)
+    assert [p.name for p in take] == ["f1", "f2", "f3"]
+    # family smaller than the batch: leftover slots backfill queue order
+    q2 = [_P("f1", "fam"), _P("s1"), _P("f2", "fam"), _P("s2")]
+    assert [p.name for p in affinity_take(q2, 3)] == ["f1", "f2", "s1"]
+
+
+def test_affinity_take_head_without_family_keeps_queue_order():
+    q = [_P("s1"), _P("f1", "fam"), _P("f2", "fam")]
+    assert [p.name for p in affinity_take(q, 2)] == ["s1", "f1"]
+    assert affinity_take([], 4) == []
+    assert affinity_take(q, 0) == []
+
+
+# -------------------------------------------- scheduler formation (no jax)
+
+
+def _cfg(buckets=(8, 16), max_batch=2, **serve_kw):
+    serve_kw.setdefault("mds_iters", 10)
+    return Config(
+        model=ModelConfig(dim=32, depth=1, heads=2, dim_head=16,
+                          max_seq_len=3 * max(buckets), bfloat16=False),
+        data=DataConfig(msa_depth=2),
+        serve=ServeConfig(buckets=buckets, max_batch=max_batch, **serve_kw),
+    )
+
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+class _FakeEngine:
+    """Dispatch recorder (same stand-in shape as tests/test_scheduler.py)."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.buckets = cfg.serve.buckets
+        self.max_batch = cfg.serve.max_batch
+        self.mesh_desc = None
+        self.counters = EventCounters()
+        self.tracer = Tracer(enabled=False)
+        self.dispatched = []
+
+    def batch_for(self, bucket):
+        return self.max_batch
+
+    def dispatch_batch(self, bucket, reqs):
+        self.dispatched.append((bucket, [r.seq for r in reqs]))
+        return [
+            ServeResult(seq=r.seq, bucket=bucket,
+                        atom14=np.zeros((len(r.seq), 14, 3), np.float32),
+                        latency_s=1e-3)
+            for r in reqs
+        ]
+
+    def retry_bucket(self, bucket):
+        return None
+
+
+def _frontend(**serve_kw):
+    serve_kw.setdefault("dwell_ms", 50.0)
+    eng = _FakeEngine(_cfg(**serve_kw))
+    clock = _FakeClock()
+    fe = AsyncServeFrontend(eng, clock=clock, start=False)
+    return fe, eng, clock
+
+
+def test_scheduler_affinity_packs_hinted_family():
+    fe, eng, clock = _frontend()
+    h1 = fe.submit(ServeRequest("AAAAAA", parent_id="scan"))
+    hs = fe.submit("MKVLIT")  # stranger between two family members
+    h2 = fe.submit(ServeRequest("AAAAAC", parent_id="scan"))
+    assert fe.pump() == 1
+    # the family member jumped ahead of the stranger into the formation
+    assert eng.dispatched == [(8, ["AAAAAA", "AAAAAC"])]
+    assert h1.result(0).ok and h2.result(0).ok
+    clock.advance(0.051)
+    assert fe.pump() == 1  # the stranger still dispatches (dwell expiry)
+    assert hs.result(0).ok
+    stats = fe.stats()
+    assert stats["sched.family_members"] == 2
+    assert stats["sched.affinity_batches"] == 1
+
+
+def test_scheduler_affinity_disabled_keeps_fifo():
+    fe, eng, clock = _frontend(affinity_batching=False)
+    fe.submit(ServeRequest("AAAAAA", parent_id="scan"))
+    fe.submit("MKVLIT")
+    fe.submit(ServeRequest("AAAAAC", parent_id="scan"))
+    assert fe.pump() == 1
+    assert eng.dispatched == [(8, ["AAAAAA", "MKVLIT"])]
+    assert "sched.family_members" not in fe.stats()
+
+
+def test_scheduler_affinity_never_delays_the_head():
+    fe, eng, clock = _frontend()
+    fe.submit("MKVLIT")  # familyless head of queue
+    fe.submit(ServeRequest("AAAAAA", parent_id="scan"))
+    fe.submit(ServeRequest("AAAAAC", parent_id="scan"))
+    assert fe.pump() >= 1
+    # the oldest request rides in the first formation regardless of family
+    assert eng.dispatched[0] == (8, ["MKVLIT", "AAAAAA"])
+
+
+# ------------------------------------------------ real-engine ledger + parity
+
+
+def _engine_cfg(**serve_kw):
+    serve_kw.setdefault("mds_iters", 20)
+    serve_kw.setdefault("feature_cache_size", 64)
+    return Config(
+        model=ModelConfig(dim=32, depth=1, heads=2, dim_head=16,
+                          max_seq_len=48, bfloat16=False),
+        data=DataConfig(msa_depth=2),
+        serve=ServeConfig(buckets=(16,), max_batch=4, **serve_kw),
+    )
+
+
+@pytest.fixture(scope="module")
+def scan_engine():
+    return ServeEngine(_engine_cfg())
+
+
+def test_engine_ledger_accounts_every_request(scan_engine):
+    eng = scan_engine
+    parent = "ACDEFGHIKLMN"
+    muts = [_mutate(parent, p) for p in (0, 3, 7, 11)]
+    reqs = [ServeRequest(parent)] + [
+        ServeRequest(m, parent_id="fam0") for m in muts
+    ]
+    before = eng.counters.snapshot()
+    results = eng.predict_many(reqs)
+    after = eng.counters.snapshot()
+
+    def d(name):
+        return after.get(name, 0) - before.get(name, 0)
+
+    hits, misses, delta = (d("serve.feat_hits"), d("serve.feat_misses"),
+                           d("serve.feat_delta"))
+    # the ledger sums to the dispatched-request count, no request uncounted
+    assert hits + misses + delta == len(reqs)
+    assert misses == 1 and delta == len(muts)
+    assert all(r.ok for r in results)
+    assert [r.feat_reuse for r in results] == ["miss"] + ["delta"] * len(muts)
+    # an exact repeat of the parent is a derivation-key hit
+    again = eng.predict_many([ServeRequest(parent)])[0]
+    assert again.feat_reuse == "hit"
+    assert eng.counters.get("serve.feat_hits") >= 1
+
+
+def test_delta_served_result_matches_cold_engine(scan_engine):
+    # end-to-end parity: a structure served through the delta fast lane is
+    # byte-identical to the same request on an engine with the lane off
+    parent = "MKVLITHDSAGE"
+    mutant = _mutate(parent, 6)
+    warm = scan_engine.predict_many(
+        [ServeRequest(parent), ServeRequest(mutant, parent_id="fam1")]
+    )
+    assert warm[1].feat_reuse == "delta"
+    cold_eng = ServeEngine(_engine_cfg(feature_cache_size=0,
+                                       delta_featurize=False))
+    cold = cold_eng.predict_many([ServeRequest(mutant)])[0]
+    assert cold.feat_reuse == "miss"
+    assert np.array_equal(warm[1].atom14, cold.atom14)
