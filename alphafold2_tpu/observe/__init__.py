@@ -40,6 +40,10 @@ first-class answer:
 - :mod:`flightrec` — ``FlightRecorder``: bounded rings of recent
   telemetry dumped as a scrubbed incident file on watchdog fire,
   dispatch error, or SIGTERM.
+- :mod:`workload` — ``WorkloadRecorder``: the request STREAM itself as
+  a scrubbed, replayable JSONL artifact (fingerprints, not sequences,
+  unless opted in), plus the replay builder and the seeded synthetic
+  diurnal generator behind ``bench.py --mode serve-replay``.
 
 ``alphafold2_tpu.train.observe`` remains as a re-export shim for existing
 imports. ``scripts/obs_report.py`` summarizes the emitted artifacts.
@@ -64,6 +68,12 @@ from alphafold2_tpu.observe.tracectx import (
 )
 from alphafold2_tpu.observe.tracing import Span, Tracer
 from alphafold2_tpu.observe.watchdog import LivenessWatchdog, probe_backend
+from alphafold2_tpu.observe.workload import (
+    WorkloadRecorder,
+    build_replay,
+    load_workload,
+    synthetic_diurnal,
+)
 
 __all__ = [
     "EventCounters",
@@ -79,13 +89,17 @@ __all__ = [
     "Span",
     "TraceContext",
     "Tracer",
+    "WorkloadRecorder",
+    "build_replay",
     "current_trace",
     "flops",
+    "load_workload",
     "numerics",
     "parse_slo_specs",
     "probe_backend",
     "regress",
     "scrub_env",
+    "synthetic_diurnal",
     "tag",
     "use_trace",
 ]

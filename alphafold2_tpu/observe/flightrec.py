@@ -87,6 +87,11 @@ class FlightRecorder:
         self._dumped: set = set()
         self._lock = threading.Lock()
         self._t0 = time.time()
+        # optional workload-tail provider (observe/workload.py): a
+        # callable returning the recorder's last-N SCRUBBED request
+        # events, included in dumps so an incident file shows what
+        # traffic preceded the death
+        self._workload_tail = None
 
     # ------------------------------------------------------------ recording
 
@@ -97,6 +102,13 @@ class FlightRecorder:
 
     def attach(self, tracer) -> "FlightRecorder":
         tracer.add_sink(self.record_event)
+        return self
+
+    def attach_workload(self, tail_provider) -> "FlightRecorder":
+        """Register ``tail_provider()`` (e.g. ``WorkloadRecorder.tail``)
+        whose return — a bounded list of already-scrubbed request events —
+        rides in every subsequent dump as ``workload_tail``."""
+        self._workload_tail = tail_provider
         return self
 
     def note(self, kind: str, **info) -> None:
@@ -123,6 +135,12 @@ class FlightRecorder:
             self._dumped.add(reason)
         if not self.directory:
             return None
+        workload_tail = None
+        if self._workload_tail is not None:
+            try:  # a broken provider must not mask the original failure
+                workload_tail = list(self._workload_tail())[-64:]
+            except Exception:
+                workload_tail = None
         doc = {
             "reason": reason,
             "time_unix": round(time.time(), 3),
@@ -135,6 +153,11 @@ class FlightRecorder:
             "metric_snapshots": list(self._snapshots),
             # newest-last; ts values are on the tracer's process timebase
             "events": list(self._events),
+            # last-N request events from the workload ring (same scrub
+            # contract as the recorder: hashed parents, no raw sequences
+            # unless that recorder opted in)
+            **({"workload_tail": workload_tail}
+               if workload_tail is not None else {}),
             **({"extra": extra} if extra else {}),
         }
         try:

@@ -144,6 +144,28 @@ KERNELS_THRESHOLDS = {
     "stock_ms_total": ("lower", 1.50),
 }
 
+# workload record→replay records (bench.py --mode serve-replay): a
+# recorded (or synthetic-diurnal) request stream replayed against a fresh
+# engine in the same process. Throughput/latency ratios get the standard
+# wide cross-machine tolerances; the STRUCTURAL claims — the loop this
+# mode exists to close — are absolute gates judged on the current record
+# alone: the replay must reproduce the recording's feature-reuse ledger
+# EXACTLY (ledger_match is 1.0 or the replay is not deterministic), the
+# replayed lifecycles must still reconstruct complete traces, and the
+# recorder itself (submit hook + resolve hook + JSONL append) may cost
+# <=5% goodput measured on/off on a warm engine, exactly like
+# telemetry_overhead_frac.
+REPLAY_THRESHOLDS = {
+    "value": ("higher", 0.50),  # replayed ok-residues/sec
+    "goodput_rps": ("higher", 0.50),
+    "p50_ms": ("lower", 2.00),
+    "p95_ms": ("lower", 2.00),
+    "ledger_match": ("absmin", 1.0),  # exact reuse-ledger reproduction
+    "replay_bytes_identical": ("absmin", 1.0),  # (seq, seed) determinism
+    "trace_complete_fraction": ("absmin", 0.99),
+    "recorder_overhead_frac": ("absmax", 0.05),
+}
+
 
 def thresholds_for(record) -> dict:
     """The gate's per-metric direction/tolerance table for this record's
@@ -152,6 +174,8 @@ def thresholds_for(record) -> dict:
         return SERVE_ASYNC_THRESHOLDS
     if isinstance(record, dict) and record.get("mode") == "serve-scan":
         return SERVE_SCAN_THRESHOLDS
+    if isinstance(record, dict) and record.get("mode") == "serve-replay":
+        return REPLAY_THRESHOLDS
     if isinstance(record, dict) and record.get("mode") == "kernels":
         return KERNELS_THRESHOLDS
     if isinstance(record, dict) and record.get("mesh"):
@@ -203,8 +227,11 @@ def comparable_reason(current: dict, baseline: dict) -> Optional[str]:
     # baselines), never as silent ratio drift.
     # "scan" fences variant-scan fast-lane records: their value is an
     # amortized near-duplicate-traffic number that must never ratio
-    # against a plain serve record (or vice versa)
-    for key in ("mesh", "dtype", "kernels", "pipeline", "scan"):
+    # against a plain serve record (or vice versa). "replay" fences the
+    # record→replay loop's knobs the same way — a time-warped or
+    # load-scaled replay measures a different offered stream than the
+    # flagship synthetic run the baseline committed.
+    for key in ("mesh", "dtype", "kernels", "pipeline", "scan", "replay"):
         if current.get(key) != baseline.get(key):
             return (
                 f"{key} mismatch: current={current.get(key)!r} "

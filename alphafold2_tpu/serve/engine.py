@@ -143,6 +143,14 @@ class ServeResult:
     # (column-patched from a cached parent). None on non-dispatched
     # results (rejected / deadline / result-cache hits).
     feat_reuse: Optional[str] = None
+    # per-request cost ledger: the request's even share of the batch it
+    # rode in — queue_wait_s, device_share_s (dispatch wall over real
+    # members), compile_share_s (executable compile seconds amortized
+    # over that executable's dispatches so far, then split), flops_share
+    # (analytic executable flops over real members), pad_fraction (the
+    # batch rectangle's padded slots+residues fraction). None on
+    # non-dispatched results.
+    cost: Optional[dict] = None
 
     @property
     def ok(self) -> bool:
@@ -284,6 +292,11 @@ class ServeEngine:
         self.executed_flops_breakdown: dict = {}
         self._exe_flops: dict = {}
         self._exe_breakdown: dict = {}
+        # per-executable compile seconds + dispatch counts: the cost
+        # ledger's amortized-compile denominator (compile_s / dispatches,
+        # so early dispatches carry more of the build than late ones)
+        self._exe_compile_s: dict = {}
+        self._exe_dispatches: dict = {}
         if self.serve_dtype == "bfloat16":
             compute_dtype = jnp.bfloat16
         else:
@@ -560,9 +573,10 @@ class ServeEngine:
                 collectives = collective_census(compiled.as_text())
             except Exception:  # census is diagnostics, never a serve fault
                 collectives = {}
+        self._exe_compile_s[key] = round(time.perf_counter() - t0, 4)
         self.compile_records.append({
             "bucket": bucket, "batch": batch,
-            "seconds": round(time.perf_counter() - t0, 4),
+            "seconds": self._exe_compile_s[key],
             # donation audit: how many argument buffers we asked XLA to
             # donate, and how many shapes XLA reported back as unaliasable
             # (counted off the warning text) — a silently-dropped donation
@@ -752,6 +766,9 @@ class ServeEngine:
         # completion worker, hence the lock
         with self._account_lock:
             self.executed_flops += self._exe_flops.get(exe_key, 0.0)
+            self._exe_dispatches[exe_key] = (
+                self._exe_dispatches.get(exe_key, 0) + 1
+            )
             for kernel, flops in self._exe_breakdown.get(
                 exe_key, {}
             ).items():
@@ -759,14 +776,41 @@ class ServeEngine:
                     self.executed_flops_breakdown.get(kernel, 0.0) + flops
                 )
 
+    def _request_cost(
+        self, bucket: int, batch: int, n_real: int, real_residues: int,
+        wait: float, dispatch_s: float,
+    ) -> dict:
+        """One request's even share of its batch — the per-request cost
+        ledger (``ServeResult.cost``). Amortized compile uses this
+        executable's compile seconds over its dispatch count SO FAR
+        (``_account_flops`` runs first, so the divisor is >= 1): the first
+        dispatch carries the whole build, the Nth carries 1/N of it."""
+        exe_key = self._exe_key(bucket, batch)
+        with self._account_lock:
+            dispatches = max(1, self._exe_dispatches.get(exe_key, 1))
+        compile_s = self._exe_compile_s.get(exe_key, 0.0)
+        flops = self._exe_flops.get(exe_key, 0.0)
+        rect = max(1, batch * bucket)
+        return {
+            "queue_wait_s": round(wait, 6),
+            "device_share_s": round(dispatch_s / n_real, 6),
+            "compile_share_s": round(compile_s / dispatches / n_real, 6),
+            "flops_share": round(flops / n_real, 3),
+            "pad_fraction": round(
+                max(0, rect - real_residues) / rect, 4
+            ),
+        }
+
     def _build_results(
         self, bucket, reqs, waits, dispatch_s, refined, weights, disto,
-        feat=None,
+        feat=None, batch=None,
     ) -> list:
         """Unpad/realize one batch's outputs into per-request results.
         ``feat`` (optional, slot-aligned) carries each request's
-        featurization-reuse ledger entry onto its result."""
+        featurization-reuse ledger entry onto its result; ``batch`` (the
+        padded batch dimension) enables the per-request cost ledger."""
         built = []
+        real_residues = sum(len(r.seq) for r in reqs)
         for slot, req in enumerate(reqs):
             L = len(req.seq)
             atom14 = refined[slot, :L]
@@ -788,6 +832,13 @@ class ServeEngine:
                 dispatch_s=dispatch_s,
                 trace_id=req.trace.trace_id if req.trace else None,
                 feat_reuse=feat[slot] if feat is not None else None,
+                cost=(
+                    self._request_cost(
+                        bucket, batch, len(reqs), real_residues,
+                        wait, dispatch_s,
+                    )
+                    if batch else None
+                ),
             ))
         return built
 
@@ -1008,7 +1059,7 @@ class ServeEngine:
             ):
                 built = self._build_results(
                     bucket, chunk_reqs, waits, dispatch_s,
-                    refined, weights, disto, feat=feat,
+                    refined, weights, disto, feat=feat, batch=batch,
                 )
             for idx, res in zip(chunk_idx, built):
                 results[idx] = res
@@ -1051,7 +1102,7 @@ class ServeEngine:
         ):
             built = self._build_results(
                 job.bucket, reqs, waits, dispatch_s, refined, weights,
-                disto, feat=job.feat,
+                disto, feat=job.feat, batch=job.batch_size,
             )
         member_traces = [r.trace.trace_id for r in reqs if r.trace]
         # the batch span is retroactive (its start predates this thread's

@@ -201,6 +201,7 @@ class AsyncServeFrontend:
         )
         self._lock = threading.Condition()
         self._observers: list = []  # fn(result, priority) at every resolve
+        self._submit_observers: list = []  # fn(req, bucket, family)
         self._queues: dict = {}  # bucket -> list[_Pending], priority-sorted
         # bucket -> (DispatchHandle, [_Pending]) while that batch's host
         # stage is still joinable; completion pops its own entry
@@ -290,6 +291,22 @@ class AsyncServeFrontend:
             except Exception:
                 pass  # an observer must never take the serving path down
 
+    def add_submit_observer(self, fn: Callable) -> None:
+        """Register ``fn(request, bucket, family)``, called once per
+        submitted request at arrival — BEFORE admission control, so the
+        observer sees the offered stream (rejects and sheds included),
+        not just what the queue accepted. ``bucket``/``family`` are None
+        for unservable requests / non-family traffic. The workload
+        recorder's ingestion point (``observe/workload.py``)."""
+        self._submit_observers.append(fn)
+
+    def _notify_submit(self, req: ServeRequest, bucket, family) -> None:
+        for fn in self._submit_observers:
+            try:
+                fn(req, bucket, family)
+            except Exception:
+                pass  # same contract as _notify: never break serving
+
     def _trace_resolve(
         self, tctx: Optional[TraceContext], result: ServeResult
     ) -> None:
@@ -359,6 +376,7 @@ class AsyncServeFrontend:
                 "sched.reject", reason="unservable",
                 **(tctx.child().event_args() if tctx is not None else {}),
             )
+            self._notify_submit(req, None, None)
             self._trace_resolve(tctx, res)
             self._notify(res, priority)
             return handle
@@ -371,6 +389,7 @@ class AsyncServeFrontend:
             family = self.families.observe(req.seq, req.parent_id)
             if family is not None:
                 self.counters.bump("sched.family_members")
+        self._notify_submit(req, bucket, family)
 
         # mesh identity rides in the key (serve/cache.py): results from a
         # sharded engine and a single-device one are numerically close but

@@ -412,6 +412,57 @@ def report_variant_scan(latest: dict) -> None:
               f"({ok})")
 
 
+def report_replay(latest: dict) -> None:
+    """Record-vs-replay section: printed when a ``--mode serve-replay``
+    bench record rode the file. Shows the recording source and replay
+    knobs, the replay-vs-record goodput/latency diff, the structural
+    verdicts the CI gate judges absolutely (exact reuse-ledger
+    reproduction, byte-identical (seq, seed) outputs, trace completeness)
+    and the measured recorder overhead."""
+    if latest.get("mode") != "serve-replay":
+        return
+    knobs = (f"warp {latest.get('time_warp', 1)}x, "
+             f"scale {latest.get('load_scale', 1)}x")
+    print(f"-- record vs replay ({latest.get('source', '?')}, {knobs}) --")
+    goodput = latest.get("goodput_rps")
+    rec_goodput = latest.get("record_goodput_rps")
+    if goodput is not None:
+        line = f"  replay goodput:  {goodput} req/s"
+        if rec_goodput:
+            ratio = latest.get("replay_vs_record_goodput")
+            line += f"  (recorded {rec_goodput} req/s"
+            if ratio is not None:
+                line += f", {ratio}x"
+            line += ")"
+        print(line)
+    if latest.get("p50_ms") is not None:
+        line = (f"  replay latency:  p50 {latest['p50_ms']}ms  "
+                f"p95 {latest.get('p95_ms')}ms")
+        if latest.get("record_p50_ms") is not None:
+            line += (f"  (recorded p50 {latest['record_p50_ms']}ms  "
+                     f"p95 {latest.get('record_p95_ms')}ms)")
+        print(line)
+    match = latest.get("ledger_match")
+    if match is not None:
+        verdict = "EXACT" if match >= 1.0 else "MISMATCH"
+        print(f"  reuse ledger:    {verdict} reproduction of the "
+              f"recording's hit/delta/miss ledger")
+    bytes_id = latest.get("replay_bytes_identical")
+    if bytes_id is not None:
+        verdict = ("byte-identical" if bytes_id >= 1.0
+                   else f"DIVERGED ({bytes_id:.1%} matched)")
+        print(f"  (seq, seed):     {verdict} atom14 outputs across arms")
+    frac = latest.get("trace_complete_fraction")
+    if frac is not None:
+        print(f"  replay traces:   {frac:.1%} complete")
+    overhead = latest.get("recorder_overhead_frac")
+    if overhead is not None:
+        print(f"  recorder cost:   {overhead:.1%} goodput overhead "
+              f"(on/off on the warm engine)")
+    if latest.get("workload_log"):
+        print(f"  recording:       {latest['workload_log']}")
+
+
 def report_kernels(latest: dict) -> None:
     """Kernels/precision section: printed when records carry the kernel-
     policy or serving-dtype keys (ops/kernels.py KernelPolicy, serve.dtype)
@@ -608,6 +659,7 @@ def report_metrics(path: str) -> list:
     report_train(records)
     report_scheduler(latest)
     report_variant_scan(latest)
+    report_replay(latest)
     report_slo(latest)
     report_mesh(latest)
     report_kernels(latest)
