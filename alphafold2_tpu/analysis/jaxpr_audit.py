@@ -568,6 +568,63 @@ def hlo_findings(target_names=None) -> list:
     ]
 
 
+# ---------------------------------------------------- concurrency rule set
+
+
+def concurrency_findings() -> list:
+    """Run static layer 5 in-process — the concurrency auditor (AF2C:
+    lock-order graph, guard contracts, thread/queue lifecycles), its
+    committed-contract check, and the knob registry (AF2K) — and fold
+    the findings into this stream.
+
+    In-process because it is pure stdlib AST: no jax, no backend, no
+    subprocess. The contract check honors the same stale-baseline escape
+    as the graph/hlo gates; gated-defect functions (the
+    ``AF2TPU_AUDIT_INVERT_LOCKS`` negative control) surface here as
+    findings when their env var is set but never enter the contracts. A
+    crashed scan must never read as green — it becomes AF2C000."""
+    from alphafold2_tpu.analysis import concurrency, knobs
+
+    findings: list = []
+    try:
+        model = concurrency.build_model()
+        for f in model.findings():
+            findings.append(AuditFinding(
+                f.rule, f.severity, "concurrency",
+                f"{f.path}:{f.line}: {f.message}",
+            ))
+        verdict, lines = concurrency.check_against(
+            concurrency.DEFAULT_BASELINE, concurrency.compute_contracts(model)
+        )
+        if verdict == "stale-baseline":
+            print(
+                "jaxpr_audit: concurrency gate reports a STALE baseline "
+                "(format changed) — re-baseline concurrency_contracts.json"
+            )
+        elif verdict != "pass":
+            for line in lines:
+                findings.append(AuditFinding(
+                    "AF2C009", "error", "concurrency_contracts", line,
+                ))
+    except Exception as e:  # noqa: BLE001 — a broken gate must be loud
+        findings.append(AuditFinding(
+            "AF2C000", "error", "concurrency",
+            f"concurrency audit crashed: {type(e).__name__}: {e}",
+        ))
+    try:
+        for f in knobs.audit():
+            findings.append(AuditFinding(
+                f.rule, f.severity, "knobs",
+                f"{f.path}:{f.line}: {f.message}",
+            ))
+    except Exception as e:  # noqa: BLE001
+        findings.append(AuditFinding(
+            "AF2C000", "error", "knobs",
+            f"knob audit crashed: {type(e).__name__}: {e}",
+        ))
+    return findings
+
+
 # --------------------------------------------------------------------- CLI
 
 
@@ -600,8 +657,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--rules", default="jaxpr",
         help=(
-            "comma-separated rule sets: jaxpr, lowering, hlo "
-            "(default: jaxpr)"
+            "comma-separated rule sets: jaxpr, lowering, hlo, "
+            "concurrency (default: jaxpr)"
         ),
     )
     parser.add_argument(
@@ -611,7 +668,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     rule_sets = {s.strip() for s in args.rules.split(",") if s.strip()}
-    unknown = rule_sets - {"jaxpr", "lowering", "hlo"}
+    unknown = rule_sets - {"jaxpr", "lowering", "hlo", "concurrency"}
     if unknown:
         print(f"unknown rule set(s): {sorted(unknown)}")
         return 2
@@ -634,6 +691,8 @@ def main(argv=None) -> int:
         findings.extend(lowering_findings())
     if "hlo" in rule_sets:
         findings.extend(hlo_findings())
+    if "concurrency" in rule_sets:
+        findings.extend(concurrency_findings())
 
     for f in findings:
         print(f.format())

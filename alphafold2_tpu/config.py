@@ -18,25 +18,25 @@ from typing import Optional, Tuple
 
 @dataclass
 class ModelConfig:
-    dim: int = 256
-    max_seq_len: int = 2048
-    depth: int = 6
-    heads: int = 8
-    dim_head: int = 64
-    attn_dropout: float = 0.0
-    ff_dropout: float = 0.0
+    dim: int = 256  # trunk embedding width (single-repr channels)
+    max_seq_len: int = 2048  # positional-embedding table size (max residues)
+    depth: int = 6  # trunk layers (MSA+pair block repeats)
+    heads: int = 8  # attention heads per layer
+    dim_head: int = 64  # per-head channel width
+    attn_dropout: float = 0.0  # attention-prob dropout rate (train only)
+    ff_dropout: float = 0.0  # feedforward dropout rate (train only)
     # exact erf GELU in the GEGLU feedforwards (the reference's torch
     # F.gelu); default False = tanh approximation, the faster form on TPU
     gelu_exact: bool = False
-    remat: bool = False
+    remat: bool = False  # rematerialize trunk layers (memory for recompute)
     # remat checkpoint policy: None/"nothing" (save nothing — max memory
     # savings) | "dots" | "dots_no_batch" (save matmul outputs: backward
     # skips recomputing MXU-heavy ops — the memory/MFU trade)
     remat_policy: Optional[str] = None
     reversible: bool = False  # inversion-based O(1)-memory trunk engine
-    sparse_self_attn: bool = False
-    cross_attn_compress_ratio: int = 1
-    msa_tie_row_attn: bool = False
+    sparse_self_attn: bool = False  # block-sparse axial self-attention
+    cross_attn_compress_ratio: int = 1  # pair-token pooling for cross-attn
+    msa_tie_row_attn: bool = False  # tie row-attention logits across MSA rows
     # shard the MSA-row axis over sp: the tied-row logit sum completes via
     # an XLA-inserted psum, scaling MSA depth across the mesh
     msa_row_shard: bool = False
@@ -50,7 +50,7 @@ class ModelConfig:
     # compile the trunk as ONE scanned layer with stacked params (compile
     # time independent of depth); needs homogeneous layers
     scan_layers: bool = False
-    template_attn_depth: int = 2
+    template_attn_depth: int = 2  # template pointwise-attention layers
     bfloat16: bool = True  # compute dtype on TPU
     # parameter init distributions: "flax" (lecun-normal Dense, N(0,1/dim)
     # embeddings) | "torch" (the reference's module defaults — see
@@ -64,22 +64,22 @@ class MeshConfig:
     seq_parallel: int = 1  # sp axis size (pair-map row sharding)
     # 2D pair-grid sharding (parallel/grid_parallel.py); both > 1 builds a
     # (dp, spr, spc) mesh instead of (dp, sp)
-    grid_rows: int = 1
-    grid_cols: int = 1
+    grid_rows: int = 1  # spr axis (pair-row shards)
+    grid_cols: int = 1  # spc axis (pair-col shards)
 
 
 @dataclass
 class DataConfig:
     crop_len: int = 128  # residues per crop (static shape)
-    msa_depth: int = 5
-    msa_len: int = 64
-    batch_size: int = 1
+    msa_depth: int = 5  # MSA rows per example
+    msa_len: int = 64  # MSA row length (columns)
+    batch_size: int = 1  # examples per training batch
     max_len_filter: int = 250  # drop chains longer than this (train_pre.py:47)
-    min_len_filter: int = 16
+    min_len_filter: int = 16  # drop chains shorter than this
     source: str = "synthetic"  # "synthetic" | "native" | "npz" | "sidechainnet"
-    casp_version: int = 12
-    thinning: int = 30
-    data_dir: Optional[str] = None
+    casp_version: int = 12  # sidechainnet CASP release to load
+    thinning: int = 30  # sidechainnet thinning percentage
+    data_dir: Optional[str] = None  # on-disk dataset root for "npz"/"native"
     # feature stream fed beside the sequence (reference train_end2end.py:22-28
     # FEATURES): "msa" | "plm" (frozen PLM embeddings via data/plm.py) | "none"
     features: str = "msa"
@@ -162,15 +162,15 @@ class TrainConfig:
     learning_rate: float = 3e-4  # train_pre.py:18
     num_steps: int = 100000  # train_pre.py:14 NUM_BATCHES
     gradient_accumulate_every: int = 16  # train_pre.py:16
-    warmup_steps: int = 1000
-    weight_decay: float = 0.0
-    seed: int = 0
-    log_every: int = 50
-    checkpoint_every: int = 1000
-    checkpoint_dir: Optional[str] = None
-    keep_checkpoints: int = 3
+    warmup_steps: int = 1000  # linear LR warmup steps before cosine decay
+    weight_decay: float = 0.0  # AdamW decoupled weight decay
+    seed: int = 0  # PRNG seed for params + data order
+    log_every: int = 50  # steps between train-metric log lines
+    checkpoint_every: int = 1000  # steps between checkpoint writes
+    checkpoint_dir: Optional[str] = None  # checkpoint root; None disables
+    keep_checkpoints: int = 3  # newest checkpoints retained (older pruned)
     profile_dir: Optional[str] = None  # jax.profiler trace output
-    profile_steps: Tuple[int, int] = (10, 13)
+    profile_steps: Tuple[int, int] = (10, 13)  # [start, end) profiled steps
     # observe.Tracer span output (Chrome trace-event JSONL, Perfetto-
     # loadable): per-step host-side spans beside the XLA profile above
     trace_events: Optional[str] = None
@@ -193,11 +193,11 @@ def _tuplify(section, name):
 
 @dataclass
 class Config:
-    model: ModelConfig = field(default_factory=ModelConfig)
-    mesh: MeshConfig = field(default_factory=MeshConfig)
-    data: DataConfig = field(default_factory=DataConfig)
-    train: TrainConfig = field(default_factory=TrainConfig)
-    serve: ServeConfig = field(default_factory=ServeConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)  # architecture
+    mesh: MeshConfig = field(default_factory=MeshConfig)  # device mesh axes
+    data: DataConfig = field(default_factory=DataConfig)  # dataset + features
+    train: TrainConfig = field(default_factory=TrainConfig)  # optimizer loop
+    serve: ServeConfig = field(default_factory=ServeConfig)  # inference plane
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)

@@ -96,8 +96,9 @@ class FlightRecorder:
     # ------------------------------------------------------------ recording
 
     def record_event(self, event: dict) -> None:
-        """Tracer-sink callback (called under the tracer's lock: a deque
-        append only, no locks of our own — no deadlock surface)."""
+        """Tracer-sink callback (invoked outside the tracer's lock from
+        its per-event sink snapshot: a deque append only, no locks of our
+        own — no deadlock surface)."""
         self._events.append(event)
 
     def attach(self, tracer) -> "FlightRecorder":

@@ -92,23 +92,28 @@ class Tracer:
 
     def add_sink(self, sink) -> None:
         """Register a callback receiving every emitted event dict (the
-        flight recorder's ring buffer attaches here). Called under the
-        tracer lock — sinks must be cheap and must not re-enter."""
+        flight recorder's ring buffer attaches here). Sinks are invoked
+        *outside* the tracer lock from a per-event snapshot, so a slow or
+        re-entrant sink cannot stall or deadlock emitters."""
         with self._lock:
             if sink not in self._sinks:
                 self._sinks.append(sink)
 
     def _emit(self, event: dict) -> None:
+        # record + persist under the lock; snapshot the sink list and
+        # invoke outside it (a sink that emits, or blocks, must not hold
+        # every other emitting thread hostage)
         with self._lock:
             self._events.append(event)
-            for sink in self._sinks:
-                try:
-                    sink(event)
-                except Exception:
-                    pass  # a broken sink must never lose the trace itself
+            sinks = list(self._sinks)
             if self._file is not None:
                 self._file.write(json.dumps(event) + ",\n")
                 self._file.flush()
+        for sink in sinks:
+            try:
+                sink(event)
+            except Exception:
+                pass  # a broken sink must never lose the trace itself
 
     @contextmanager
     def span(self, name: str, **args):

@@ -80,6 +80,7 @@ from alphafold2_tpu.serve.bucketing import (
     formation_ripe,
 )
 from alphafold2_tpu.serve.cache import ResultCache, result_key
+from alphafold2_tpu.serve.pipeline import DispatchHandle, PipelineBatch
 from alphafold2_tpu.serve.engine import (
     ServeEngine,
     ServeRequest,
@@ -435,7 +436,13 @@ class AsyncServeFrontend:
                 # when it loses. (Lock order: scheduler lock -> batch
                 # membership lock, never the reverse.)
                 forming = self._forming.get(bucket)
-                if forming is not None and forming[0].try_join(req):
+                # typed so the static concurrency auditor sees this as
+                # the AsyncServeFrontend._lock -> PipelineBatch._lock
+                # edge (try_join acquires the membership lock)
+                dh: Optional[DispatchHandle] = (
+                    forming[0] if forming is not None else None
+                )
+                if dh is not None and dh.try_join(req):
                     pending = _Pending(
                         req=req, handle=handle, key=key, bucket=bucket,
                         priority=priority, enqueued=now, deadline=None,
@@ -807,3 +814,22 @@ class AsyncServeFrontend:
                 if self._stop:
                     return
             self.pump()
+
+
+def _audit_invert_locks(  # af2: gated-defect[AF2TPU_AUDIT_INVERT_LOCKS]
+    frontend: AsyncServeFrontend, batch: PipelineBatch
+) -> None:
+    """Seeded negative control for the static concurrency gate.
+
+    Never executed: the ``gated-defect`` marker keeps this function out
+    of the audit (and out of ``concurrency_contracts.json``) unless
+    ``AF2TPU_AUDIT_INVERT_LOCKS=1``, in which case it contributes the
+    *inverted* acquisition order — batch membership lock taken first,
+    scheduler lock inside it — closing a cycle against ``submit``'s
+    documented ``AsyncServeFrontend._lock -> PipelineBatch._lock`` edge.
+    CI flips the env var and asserts the gate exits 1 naming the cycle;
+    no bench run and no thread ever executes this body.
+    """
+    with batch._lock:
+        with frontend._lock:
+            pass

@@ -1,0 +1,50 @@
+"""CLI for static layer 5: the concurrency auditor + knob registry.
+
+    python scripts/af2_concurrency.py                       # audit + knobs
+    python scripts/af2_concurrency.py --graph               # lock-order graph
+    python scripts/af2_concurrency.py --check               # vs committed
+                                                            #  concurrency_contracts.json
+    python scripts/af2_concurrency.py --update              # re-baseline
+    python scripts/af2_concurrency.py --knobs-markdown      # README tables
+
+Thin wrapper over ``alphafold2_tpu.analysis.concurrency`` (lock-order
+graph, guard contracts, thread/queue lifecycles — AF2C rules) and
+``alphafold2_tpu.analysis.knobs`` (AF2TPU_* env-knob registry — AF2K
+rules). Pure stdlib (no jax import), so the CI job runs in milliseconds
+and before any backend exists. Exit codes: 0 clean, 1 findings/drift,
+2 missing baseline or usage error. The exit code is the max of the two
+audits so one command gates both.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from alphafold2_tpu.analysis import concurrency, knobs  # noqa: E402
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--knobs-markdown" in argv:
+        return knobs.main(["--markdown"])
+    if "--no-knobs" in argv:
+        return concurrency.main([a for a in argv if a != "--no-knobs"])
+    rc = concurrency.main(argv)
+    # graph/update/list-rules are single-purpose introspection modes;
+    # the knob audit rides along only on the gating paths
+    if any(a in argv for a in ("--graph", "--update", "--list-rules")):
+        return rc
+    knob_rc = knobs.main([a for a in argv if a in ("--json",)])
+    return max(rc, knob_rc)
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # piping into `head` closes stdout early; that's not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

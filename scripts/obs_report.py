@@ -54,10 +54,12 @@ def _fmt_s(seconds: float) -> str:
 
 def classify(path: str) -> str:
     """"trace" (Chrome trace events) vs "metrics" (MetricsLogger JSONL) vs
-    "hlo-contracts" (analysis/hlo_audit.py snapshot): trace files open with
-    ``[`` or hold events with a ``ph`` key; metrics lines are flat records
-    with a ``step`` key; an hlo_contracts.json is a single pretty-printed
-    object with ``format`` + ``targets``."""
+    "hlo-contracts" (analysis/hlo_audit.py snapshot) vs
+    "concurrency-contracts" (analysis/concurrency.py baseline): trace files
+    open with ``[`` or hold events with a ``ph`` key; metrics lines are
+    flat records with a ``step`` key; an hlo_contracts.json is a single
+    pretty-printed object with ``format`` + ``targets``; a
+    concurrency_contracts.json has ``format`` + ``lock_graph``."""
     with open(path) as f:
         head = f.read(4096).lstrip()
     if head.startswith("["):
@@ -71,6 +73,9 @@ def classify(path: str) -> str:
         if (isinstance(doc, dict) and "format" in doc
                 and isinstance(doc.get("targets"), dict)):
             return "hlo-contracts"
+        if (isinstance(doc, dict) and "format" in doc
+                and isinstance(doc.get("lock_graph"), dict)):
+            return "concurrency-contracts"
     first = head.splitlines()[0] if head else "{}"
     try:
         rec = json.loads(first)
@@ -620,6 +625,39 @@ def report_hlo_contracts(path: str) -> list:
     return []
 
 
+def report_concurrency_contracts(path: str) -> list:
+    """Static layer-5 contract section for a committed (or freshly
+    ``--update``-written) concurrency_contracts.json: the lock-order
+    graph's named edges with their witness acquisition sites, and the
+    per-class guard map — the shape ``analysis/concurrency.py --check``
+    diffs in CI, rendered for humans. Always returns [] (a malformed
+    file raises into main()'s existing error path)."""
+    with open(path) as f:
+        doc = json.load(f)
+    edges = doc.get("lock_graph") or {}
+    guards = doc.get("guards") or {}
+    n_guards = sum(len(v) for v in guards.values())
+    print(f"== concurrency contracts {path}: {len(edges)} lock-graph "
+          f"edge(s), {n_guards} guarded attribute(s) across "
+          f"{len(guards)} class(es) (format {doc.get('format')}) ==")
+    if edges:
+        print("  lock-order graph (acquire left before right):")
+        for edge in sorted(edges):
+            print(f"    {edge}    [{edges[edge]}]")
+    else:
+        print("  lock-order graph: no nested acquisitions (trivially "
+              "acyclic)")
+    for cls in sorted(guards):
+        attrs = guards[cls]
+        by_lock: dict = {}
+        for attr, lock in attrs.items():
+            by_lock.setdefault(lock, []).append(attr)
+        print(f"  {cls}:")
+        for lock in sorted(by_lock):
+            print(f"    {lock} guards: {', '.join(sorted(by_lock[lock]))}")
+    return []
+
+
 def report_metrics(path: str) -> list:
     """Latest-value dump + per-domain sections. Returns the list of
     malformed-line descriptions (empty = clean) for main()'s summary —
@@ -707,6 +745,7 @@ def main(argv=None) -> int:
             reporter = {
                 "trace": report_trace,
                 "hlo-contracts": report_hlo_contracts,
+                "concurrency-contracts": report_concurrency_contracts,
             }.get(kind, report_metrics)
             errs = reporter(path)
             if errs:

@@ -64,3 +64,92 @@ def no_implicit_transfers():
 
     with jax.transfer_guard("disallow"):
         yield
+
+
+class LockWitness:
+    """Test-only instrumented-lock recorder for validating the static
+    lock-order graph (alphafold2_tpu/analysis/concurrency.py) against
+    runtime reality.
+
+    ``wrap(obj, attr, label)`` replaces a ``threading`` lock attribute
+    with a transparent proxy; every acquisition made while another
+    wrapped lock is held on the same thread records the observed edge
+    ``(held_label, acquired_label)``. Threaded slow-tier tests then
+    assert every observed edge appears in the static graph — the model
+    validates against reality, and a runtime acquisition the auditor
+    cannot see statically fails loudly instead of silently diverging.
+    """
+
+    def __init__(self):
+        import threading
+
+        self._tls = threading.local()
+        self._rec_lock = threading.Lock()
+        self.edges = set()  # {(held_label, acquired_label)}
+
+    def _held(self):
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    class _Proxy:
+        def __init__(self, witness, inner, label):
+            self._w = witness
+            self._inner = inner
+            self._label = label
+
+        def acquire(self, *a, **k):
+            got = self._inner.acquire(*a, **k)
+            if got is not False:
+                held = self._w._held()
+                if held:
+                    with self._w._rec_lock:
+                        self._w.edges.add((held[-1], self._label))
+                held.append(self._label)
+            return got
+
+        def release(self):
+            held = self._w._held()
+            if self._label in held:
+                held.remove(self._label)
+            return self._inner.release()
+
+        def __enter__(self):
+            self.acquire()
+            return self
+
+        def __exit__(self, *exc):
+            self.release()
+            return False
+
+        def __getattr__(self, name):
+            # Condition.wait/notify, Semaphore internals, etc. pass through;
+            # wait() releases and re-acquires the underlying lock itself, so
+            # the held stack is intentionally left alone across it
+            return getattr(self._inner, name)
+
+    def wrap(self, obj, attr: str, label: str):
+        setattr(obj, attr, self._Proxy(self, getattr(obj, attr), label))
+        return obj
+
+    def wrap_class(self, cls, attr: str, label: str):
+        """Monkeypatch ``cls.__init__`` so every future instance gets its
+        ``attr`` lock wrapped. Returns an undo callable."""
+        orig = cls.__init__
+
+        def patched(inner_self, *a, **k):
+            orig(inner_self, *a, **k)
+            self.wrap(inner_self, attr, label)
+
+        cls.__init__ = patched
+        return lambda: setattr(cls, "__init__", orig)
+
+
+@pytest.fixture
+def lock_witness():
+    """Opt-in concurrency fixture: a fresh LockWitness per test. Wrap the
+    locks under test, run the threaded scenario, then assert
+    ``witness.edges`` is a subpath of the static lock graph (see
+    tests/test_concurrency_audit.py::test_runtime_order_matches_static)."""
+    return LockWitness()

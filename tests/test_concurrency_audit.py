@@ -1,0 +1,851 @@
+"""Layer-5 concurrency auditor + knob registry tests.
+
+Three tiers:
+
+1. Synthetic fixtures (fast, jax-free): one tmp-file source per AF2C
+   rule proving the rule fires on the defect and stays silent on the
+   idiomatic fix, plus exemptions (``__init__``, ``*_locked``, noqa,
+   gated-defect modes) and the contract roundtrip
+   (compute -> write -> check: pass / drift / stale / missing).
+2. Repo-level (fast): the live tree audits clean, the committed
+   ``concurrency_contracts.json`` matches a fresh computation, the knob
+   registry is clean, and the seeded ``AF2TPU_AUDIT_INVERT_LOCKS``
+   control flips the audit to a named AF2C001 cycle without touching
+   the contracts.
+3. Slow tier: subprocess gate rc semantics, and a LockWitness-threaded
+   run through the real dispatcher asserting every runtime lock edge is
+   present in the static graph (model vs reality).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from alphafold2_tpu.analysis import concurrency, knobs
+from alphafold2_tpu.analysis.concurrency import (
+    RepoModel,
+    build_model,
+    check_against,
+    compute_contracts,
+    diff_contracts,
+    write_contracts,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def scan(tmp_path, source, gated="env"):
+    f = tmp_path / "fixture.py"
+    f.write_text(textwrap.dedent(source))
+    return RepoModel().scan_paths([str(f)], gated=gated)
+
+
+def rules_of(model):
+    return sorted(f.rule for f in model.findings())
+
+
+# ------------------------------------------------- AF2C001: lock ordering
+
+
+CYCLE_SRC = """
+    import threading
+
+    class A:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def fwd(self, b: "B"):
+            with self._lock:
+                with b._lock:
+                    pass
+
+    class B:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def rev(self, a: "A"):
+            with self._lock:
+                with a._lock:
+                    pass
+"""
+
+
+def test_af2c001_cycle_with_two_witness_paths(tmp_path):
+    model = scan(tmp_path, CYCLE_SRC)
+    found = [f for f in model.findings() if f.rule == "AF2C001"]
+    assert len(found) == 1
+    msg = found[0].message
+    # both directions of the inversion are named with their sites
+    assert "A._lock -> B._lock" in msg
+    assert "B._lock -> A._lock" in msg
+    assert "acquired at" in msg
+
+
+def test_af2c001_consistent_order_is_clean(tmp_path):
+    model = scan(tmp_path, """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fwd(self, b: "B"):
+                with self._lock:
+                    with b._lock:
+                        pass
+
+            def fwd2(self, b: "B"):
+                with self._lock:
+                    with b._lock:
+                        pass
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+    """)
+    assert ("A._lock", "B._lock") in model.edges
+    assert not model.cycles()
+    assert "AF2C001" not in rules_of(model)
+
+
+def test_af2c001_cycle_through_call_closure(tmp_path):
+    # A.outer holds A._lock and calls B.helper, which acquires B._lock;
+    # B.back holds B._lock and calls A.helper acquiring A._lock — the
+    # cycle crosses method calls, not just literal nesting.
+    model = scan(tmp_path, """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self, b: "B"):
+                with self._lock:
+                    b.helper()
+
+            def helper(self):
+                with self._lock:
+                    pass
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def helper(self):
+                with self._lock:
+                    pass
+
+            def back(self, a: "A"):
+                with self._lock:
+                    a.helper()
+    """)
+    assert ("A._lock", "B._lock") in model.edges
+    assert ("B._lock", "A._lock") in model.edges
+    assert "AF2C001" in rules_of(model)
+
+
+def test_af2c001_plain_lock_self_deadlock(tmp_path):
+    model = scan(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def boom(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """)
+    found = [f for f in model.findings() if f.rule == "AF2C001"]
+    assert len(found) == 1
+    assert "self-deadlock" in found[0].message
+
+
+def test_af2c001_rlock_reentry_is_clean(tmp_path):
+    model = scan(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def fine(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """)
+    assert rules_of(model) == []
+
+
+def test_acquire_release_pairing_tracks_held_stack(tmp_path):
+    # after release the lock is no longer held, so no A->B edge forms
+    model = scan(tmp_path, """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._other = threading.Lock()
+
+            def seq(self):
+                self._lock.acquire()
+                self._lock.release()
+                self._other.acquire()
+                self._other.release()
+    """)
+    assert model.edges == {}
+
+    model = scan(tmp_path, """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._other = threading.Lock()
+
+            def nested(self):
+                self._lock.acquire()
+                self._other.acquire()
+                self._other.release()
+                self._lock.release()
+    """)
+    assert ("A._lock", "A._other") in model.edges
+
+
+# --------------------------------------- AF2C002/003/004: guard contracts
+
+
+GUARDED_SRC = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._x = 0
+
+        def w1(self):
+            with self._lock:
+                self._x = 1
+
+        def w2(self):
+            with self._lock:
+                self._x = 2
+
+        def bad(self):
+            self._x = 3
+"""
+
+
+def test_af2c002_unguarded_write(tmp_path):
+    model = scan(tmp_path, GUARDED_SRC)
+    # guard values are bare attr names; printing/contract layers qualify
+    assert model.guards.get("C", {}).get("_x") == "_lock"
+    found = [f for f in model.findings() if f.rule == "AF2C002"]
+    assert len(found) == 1
+    assert "C._x" in found[0].message
+
+
+def test_init_writes_are_exempt(tmp_path):
+    # __init__ is the only unlocked writer -> no contract pressure and
+    # no finding, even though it never takes the lock
+    model = scan(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0
+                self._x = 1
+
+            def w(self):
+                with self._lock:
+                    self._x = 2
+    """)
+    assert model.guards.get("C", {}).get("_x") == "_lock"
+    assert rules_of(model) == []
+
+
+def test_locked_suffix_methods_count_as_guarded(tmp_path):
+    model = scan(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0
+
+            def w(self):
+                with self._lock:
+                    self._x = 1
+
+            def _bump_locked(self):
+                self._x += 1
+    """)
+    assert model.guards.get("C", {}).get("_x") == "_lock"
+    assert rules_of(model) == []
+
+
+def test_private_helper_called_only_under_lock_inherits_it(tmp_path):
+    # _flush has no lock syntax of its own, but its only call site holds
+    # C._lock — the entry-held fixpoint promotes its writes to locked
+    model = scan(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._buf = []
+
+            def add(self, item):
+                with self._lock:
+                    self._buf.append(item)
+                    self._flush()
+
+            def _flush(self):
+                self._buf = []
+    """)
+    assert model.guards.get("C", {}).get("_buf") == "_lock"
+    assert rules_of(model) == []
+
+
+def test_af2c003_mixed_guard(tmp_path):
+    model = scan(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._x = 0
+
+            def w1(self):
+                with self._a:
+                    self._x = 1
+
+            def w2(self):
+                with self._a:
+                    self._x = 2
+
+            def odd(self):
+                with self._b:
+                    self._x = 3
+    """)
+    found = [f for f in model.findings() if f.rule == "AF2C003"]
+    assert len(found) == 1
+    assert "C._a" in found[0].message and "written under _b" in found[0].message
+
+
+def test_af2c004_unlocked_iteration(tmp_path):
+    model = scan(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+
+            def wipe(self):
+                with self._lock:
+                    self._items.clear()
+
+            def snapshot(self):
+                return list(self._items.values())
+
+            def peek(self, k):
+                return self._items.get(k)
+    """)
+    found = [f for f in model.findings() if f.rule == "AF2C004"]
+    # .values() iteration flagged; single-key .get() is GIL-atomic, clean
+    assert len(found) == 1
+    assert found[0].severity == "warning"
+    assert "C._items" in found[0].message
+
+
+def test_noqa_suppresses_a_finding(tmp_path):
+    model = scan(tmp_path, GUARDED_SRC.replace(
+        "self._x = 3", "self._x = 3  # af2: noqa[AF2C002]"
+    ))
+    assert "AF2C002" not in rules_of(model)
+
+
+# --------------------------------------------- AF2C005-008: lifecycles
+
+
+def test_af2c005_thread_without_daemon_or_join(tmp_path):
+    model = scan(tmp_path, """
+        import threading
+
+        def leak():
+            t = threading.Thread(target=print)
+            t.start()
+    """)
+    assert rules_of(model) == ["AF2C005"]
+
+
+def test_af2c005_daemon_and_joined_variants_are_clean(tmp_path):
+    model = scan(tmp_path, """
+        import threading
+
+        def daemonized():
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+
+        def joined():
+            t = threading.Thread(target=print)
+            t.start()
+            t.join()
+
+        class Worker:
+            def start(self):
+                self._t = threading.Thread(target=print)
+                self._t.start()
+
+            def stop(self):
+                self._t.join()
+    """)
+    assert rules_of(model) == []
+
+
+def test_af2c006_unbounded_queue_in_threaded_class(tmp_path):
+    model = scan(tmp_path, """
+        import queue
+        import threading
+        from collections import deque
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+                self._d = deque()
+                self._ok_q = queue.Queue(maxsize=64)
+                self._ok_d = deque(maxlen=64)
+    """)
+    found = [f for f in model.findings() if f.rule == "AF2C006"]
+    assert sorted(f.message.split()[0] for f in found) == ["C._d", "C._q"]
+    assert all(f.severity == "warning" for f in found)
+
+
+def test_af2c006_silent_without_threading_evidence(tmp_path):
+    # same queues in a lockless, threadless class: not a concurrency bug
+    model = scan(tmp_path, """
+        import queue
+
+        class C:
+            def __init__(self):
+                self._q = queue.Queue()
+    """)
+    assert rules_of(model) == []
+
+
+def test_af2c007_naked_wait(tmp_path):
+    model = scan(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._ready = False
+
+            def bad(self):
+                with self._cv:
+                    self._cv.wait()
+
+            def good(self):
+                with self._cv:
+                    while not self._ready:
+                        self._cv.wait()
+
+            def also_good(self):
+                with self._cv:
+                    self._cv.wait_for(lambda: self._ready)
+    """)
+    found = [f for f in model.findings() if f.rule == "AF2C007"]
+    assert len(found) == 1
+    assert found[0].line < 12  # only the `bad` wait
+
+
+def test_af2c008_callbacks_under_lock(tmp_path):
+    model = scan(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._callbacks = []
+
+            def bad(self, ev):
+                with self._lock:
+                    for cb in self._callbacks:
+                        cb(ev)
+
+            def good(self, ev):
+                with self._lock:
+                    snapshot = list(self._callbacks)
+                for cb in snapshot:
+                    cb(ev)
+    """)
+    found = [f for f in model.findings() if f.rule == "AF2C008"]
+    assert len(found) == 1
+    assert "C._lock" in found[0].message
+
+
+def test_af2c000_unparseable_source(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def nope(:\n")
+    model = RepoModel().scan_paths([str(f)])
+    assert rules_of(model) == ["AF2C000"]
+
+
+# ------------------------------------------------ gated-defect machinery
+
+
+def _gated_fixture_source():
+    return textwrap.dedent("""
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fwd(self, b: "B"):
+                with self._lock:
+                    with b._lock:
+                        pass
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+
+        def seeded(a: A, b: B):  # af2: gated-defect[AF2C_TEST_GATE]
+            with b._lock:
+                with a._lock:
+                    pass
+    """)
+
+
+def test_gated_defect_modes(tmp_path, monkeypatch):
+    f = tmp_path / "gated.py"
+    f.write_text(_gated_fixture_source())
+
+    # env unset: the seeded inversion is invisible
+    monkeypatch.delenv("AF2C_TEST_GATE", raising=False)
+    model = RepoModel().scan_paths([str(f)], gated="env")
+    assert ("B._lock", "A._lock") not in model.edges
+
+    # env set truthy: the audit sees the cycle
+    monkeypatch.setenv("AF2C_TEST_GATE", "1")
+    model = RepoModel().scan_paths([str(f)], gated="env")
+    assert ("B._lock", "A._lock") in model.edges
+    assert "AF2C001" in rules_of(model)
+
+    # "none": always excluded even with the env set (contract path)
+    model = RepoModel().scan_paths([str(f)], gated="none")
+    assert ("B._lock", "A._lock") not in model.edges
+
+    # "all": always included even with the env unset (test path)
+    monkeypatch.delenv("AF2C_TEST_GATE", raising=False)
+    model = RepoModel().scan_paths([str(f)], gated="all")
+    assert ("B._lock", "A._lock") in model.edges
+
+
+def test_contracts_never_contain_gated_defects(tmp_path, monkeypatch):
+    f = tmp_path / "gated.py"
+    f.write_text(_gated_fixture_source())
+    monkeypatch.setenv("AF2C_TEST_GATE", "1")
+    model = RepoModel().scan_paths([str(f)], gated="env")
+    contracts = compute_contracts(model, paths=[str(f)])
+    assert "B._lock -> A._lock" not in contracts["lock_graph"]
+    assert "A._lock -> B._lock" in contracts["lock_graph"]
+
+
+# ------------------------------------------------- contract roundtrip
+
+
+def test_contract_roundtrip_pass_drift_stale_missing(tmp_path):
+    f = tmp_path / "fixture.py"
+    f.write_text(textwrap.dedent(GUARDED_SRC))
+    model = RepoModel().scan_paths([str(f)])
+    contracts = compute_contracts(model, paths=[str(f)])
+    baseline = tmp_path / "contracts.json"
+
+    verdict, lines = check_against(str(baseline), contracts)
+    assert verdict == "missing-baseline"
+
+    write_contracts(str(baseline), contracts)
+    verdict, lines = check_against(str(baseline), contracts)
+    assert (verdict, lines) == ("pass", [])
+
+    mutated = json.loads(json.dumps(contracts))
+    mutated["guards"]["C"]["_y"] = "C._lock"
+    mutated["lock_graph"]["X._a -> X._b"] = "x.py:1 (X.m)"
+    diff = diff_contracts(contracts, mutated)
+    assert any(d.startswith("lock-graph edge added") for d in diff)
+    assert any(d.startswith("guard added") for d in diff)
+    verdict, lines = check_against(str(baseline), mutated)
+    assert verdict == "drift" and lines
+
+    mutated["format"] = concurrency.FORMAT_VERSION + 1
+    verdict, lines = check_against(str(baseline), mutated)
+    assert verdict == "stale-baseline"
+
+
+def test_cli_check_and_exit_codes(tmp_path, capsys):
+    # a clean fixture: guarded writes only, no findings
+    f = tmp_path / "fixture.py"
+    f.write_text(textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0
+
+            def w1(self):
+                with self._lock:
+                    self._x = 1
+
+            def w2(self):
+                with self._lock:
+                    self._x = 2
+    """))
+    baseline = tmp_path / "contracts.json"
+    assert concurrency.main(
+        ["--update", "--baseline", str(baseline), str(f)]
+    ) == 0
+    assert concurrency.main(["--baseline", str(baseline), str(f)]) == 0
+    assert concurrency.main(
+        ["--check", "--baseline", str(baseline), str(f)]
+    ) == 0
+    assert concurrency.main(
+        ["--check", "--baseline", str(tmp_path / "nope.json"), str(f)]
+    ) == 2
+    # drift: mutate the baseline so the live graph no longer matches
+    doc = json.loads(baseline.read_text())
+    doc["guards"]["C"]["_ghost"] = "_lock"
+    baseline.write_text(json.dumps(doc))
+    assert concurrency.main(
+        ["--check", "--baseline", str(baseline), str(f)]
+    ) == 1
+    # an audit finding drives rc 1 even when contracts pass
+    f.write_text(textwrap.dedent(GUARDED_SRC))
+    concurrency.main(["--update", "--baseline", str(baseline), str(f)])
+    assert concurrency.main(
+        ["--check", "--baseline", str(baseline), str(f)]
+    ) == 1
+    capsys.readouterr()
+
+
+# ----------------------------------------------------- repo-level gates
+
+
+def test_repo_audit_is_clean():
+    model = build_model()
+    assert model.findings() == []
+
+
+def test_committed_contracts_match_reality():
+    with open(concurrency.DEFAULT_BASELINE) as fh:
+        committed = json.load(fh)
+    assert committed == compute_contracts()
+    # the one real cross-class edge the serve plane holds today
+    assert any(
+        e.startswith("AsyncServeFrontend._lock -> PipelineBatch._lock")
+        for e in committed["lock_graph"]
+    )
+
+
+def test_inverted_lock_control_fires_af2c001(monkeypatch):
+    monkeypatch.setenv("AF2TPU_AUDIT_INVERT_LOCKS", "1")
+    model = build_model()
+    found = [f for f in model.findings() if f.rule == "AF2C001"]
+    assert len(found) == 1
+    msg = found[0].message
+    assert "PipelineBatch._lock" in msg
+    assert "AsyncServeFrontend._lock" in msg
+    # the seeded defect never leaks into the contracts
+    contracts = compute_contracts(model)
+    verdict, _ = check_against(concurrency.DEFAULT_BASELINE, contracts)
+    assert verdict == "pass"
+
+
+@pytest.mark.slow
+def test_subprocess_gate_rc_semantics():
+    env = dict(os.environ)
+    env.pop("AF2TPU_AUDIT_INVERT_LOCKS", None)
+    clean = subprocess.run(
+        [sys.executable, "-m", "alphafold2_tpu.analysis.concurrency",
+         "--check"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    env["AF2TPU_AUDIT_INVERT_LOCKS"] = "1"
+    inverted = subprocess.run(
+        [sys.executable, "-m", "alphafold2_tpu.analysis.concurrency"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert inverted.returncode == 1, inverted.stdout + inverted.stderr
+    assert "AF2C001" in inverted.stdout
+    assert "PipelineBatch._lock" in inverted.stdout
+
+
+# ------------------------------------------------------- knob registry
+
+
+def test_repo_knob_audit_is_clean():
+    assert knobs.audit() == []
+
+
+def test_knob_markdown_covers_every_read():
+    reads = knobs.collect_env_reads(knobs.default_code_paths())
+    assert len(reads) >= 100  # the registry is big and should stay big
+    md = knobs.markdown_registry(reads)
+    for name in reads:
+        assert f"`{name}`" in md
+
+
+def test_af2k001_undocumented_knob(tmp_path):
+    code = tmp_path / "mod.py"
+    code.write_text('import os\nX = os.environ.get("AF2TPU_FAKE_KNOB")\n')
+    readme = tmp_path / "README.md"
+    readme.write_text("nothing here\n")
+    cfg = tmp_path / "config.py"
+    cfg.write_text("")
+    findings = knobs.audit(
+        code_paths=[str(code)], liveness_paths=[str(code)],
+        readme_path=str(readme), config_path=str(cfg),
+    )
+    assert [f.rule for f in findings] == ["AF2K001"]
+    assert "AF2TPU_FAKE_KNOB" in findings[0].message
+
+
+def test_af2k002_dead_documented_knob(tmp_path):
+    code = tmp_path / "mod.py"
+    code.write_text("")
+    readme = tmp_path / "README.md"
+    readme.write_text("set `AF2TPU_GHOST_KNOB=1` to do nothing\n")
+    cfg = tmp_path / "config.py"
+    cfg.write_text("")
+    findings = knobs.audit(
+        code_paths=[str(code)], liveness_paths=[str(code)],
+        readme_path=str(readme), config_path=str(cfg),
+    )
+    assert [f.rule for f in findings] == ["AF2K002"]
+
+
+def test_prefix_wildcard_keeps_family_alive(tmp_path):
+    code = tmp_path / "mod.py"
+    code.write_text(
+        'import os\n'
+        'PREFIX = "AF2TPU_FAM_"\n'
+        'vals = {k: v for k, v in os.environ.items()'
+        ' if k.startswith(PREFIX)}\n'
+    )
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "`AF2TPU_FAM_` prefix family: `AF2TPU_FAM_ALPHA`, "
+        "`AF2TPU_FAM_BETA`\n"
+    )
+    cfg = tmp_path / "config.py"
+    cfg.write_text("")
+    findings = knobs.audit(
+        code_paths=[str(code)], liveness_paths=[str(code)],
+        readme_path=str(readme), config_path=str(cfg),
+    )
+    assert findings == []
+
+
+def test_af2k003_and_af2k004_config_fields(tmp_path):
+    code = tmp_path / "mod.py"
+    code.write_text("def use(c):\n    return c.live_field\n")
+    readme = tmp_path / "README.md"
+    readme.write_text("")
+    cfg = tmp_path / "config.py"
+    cfg.write_text(textwrap.dedent("""
+        from dataclasses import dataclass
+
+        @dataclass
+        class FooConfig:
+            live_field: int = 1  # documented inline
+            dead_field: int = 2  # referenced nowhere
+            # block comment above counts as documentation
+            dead_but_commented: int = 3
+            naked_dead: int = 4
+    """))
+    findings = knobs.audit(
+        code_paths=[str(code)], liveness_paths=[str(code)],
+        readme_path=str(readme), config_path=str(cfg),
+    )
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f.message)
+    assert len(by_rule.get("AF2K003", [])) == 3  # all but live_field
+    k004 = by_rule.get("AF2K004", [])
+    assert len(k004) == 1 and "naked_dead" in k004[0]
+
+
+# -------------------------------------- runtime witness vs static graph
+
+
+@pytest.mark.slow
+def test_runtime_order_matches_static(lock_witness):
+    """Drive the real threaded dispatcher with instrumented locks and
+    assert every observed acquisition edge exists in the static graph —
+    the auditor's model validated against runtime reality."""
+    from alphafold2_tpu.config import (
+        Config, DataConfig, ModelConfig, ServeConfig,
+    )
+    from alphafold2_tpu.serve import pipeline as pl
+    from alphafold2_tpu.serve.engine import ServeEngine
+    from alphafold2_tpu.serve.scheduler import AsyncServeFrontend
+
+    cfg = Config(
+        model=ModelConfig(
+            dim=32, depth=1, heads=2, dim_head=16, bfloat16=False
+        ),
+        data=DataConfig(msa_depth=2),
+        serve=ServeConfig(buckets=(8, 16), max_batch=2, mds_iters=10),
+    )
+    engine = ServeEngine(cfg)
+    undo = lock_witness.wrap_class(
+        pl.PipelineBatch, "_lock", "PipelineBatch._lock"
+    )
+    try:
+        with AsyncServeFrontend(engine) as fe:
+            lock_witness.wrap(
+                fe, "_lock", "AsyncServeFrontend._lock"
+            )
+            handles = [
+                fe.submit("ACDEFG" + "K" * (i % 3)) for i in range(8)
+            ]
+            for h in handles:
+                assert h.result(timeout=180) is not None
+            admitted = fe.stats().get("sched.inflight_admitted", 0)
+    finally:
+        undo()
+
+    static_edges = {
+        (src, dst) for (src, dst) in build_model().edges
+    }
+    for edge in lock_witness.edges:
+        assert edge in static_edges, (
+            f"runtime acquired {edge[1]} while holding {edge[0]}, but the "
+            "static lock graph has no such edge — the auditor's model "
+            "diverged from reality"
+        )
+    # non-vacuity: when the continuous-batching join actually fired, the
+    # scheduler->membership edge must have been witnessed at runtime
+    if admitted > 0:
+        assert (
+            "AsyncServeFrontend._lock", "PipelineBatch._lock"
+        ) in lock_witness.edges
