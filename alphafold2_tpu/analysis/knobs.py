@@ -233,9 +233,11 @@ def audit(
 
 _GROUPS = [
     ("AF2TPU_SERVE_ASYNC_", "serve-async bench sizing"),
+    ("AF2TPU_SERVE_FLEET_", "fleet serving driver"),
     ("AF2TPU_SERVE_REPLAY_", "workload capture/replay driver"),
     ("AF2TPU_SERVE_SCAN_", "variant-scan bench driver"),
     ("AF2TPU_SERVE_", "serve bench sizing"),
+    ("AF2TPU_FLEET_", "fleet frontend"),
     ("AF2TPU_KERNELS_BENCH_", "kernel microbench"),
     ("AF2TPU_KERNELS", "kernel backend selection"),
     ("AF2TPU_BENCH_", "bench harness"),

@@ -257,3 +257,58 @@ class SLOMonitor:
 
     def alerts(self) -> list:
         return [v for v in self.evaluate() if v["alert"]]
+
+
+def aggregate_slo_verdicts(verdict_lists) -> list:
+    """Fleet-level rollup of per-replica :meth:`SLOMonitor.evaluate`
+    outputs: one ``AF2TPU_SLO_SPECS`` string fans out to one monitor per
+    replica, and this folds their verdicts back into one fleet verdict
+    per spec — burn rates averaged weighted by each replica's event count
+    (a replica that served nothing contributes nothing), event counts
+    summed, and the alert recomputed on the AGGREGATED burn (so one hot
+    replica diluted across a healthy fleet alerts fleet-wide only if the
+    fleet-wide budget is actually burning)."""
+    by_spec: dict = {}
+    order: list = []
+    for verdicts in verdict_lists:
+        for v in verdicts or ():
+            key = v["spec"]
+            if key not in by_spec:
+                by_spec[key] = []
+                order.append(key)
+            by_spec[key].append(v)
+    out = []
+    for key in order:
+        group = by_spec[key]
+        fast_n = sum(v["fast_events"] for v in group)
+        slow_n = sum(v["slow_events"] for v in group)
+        fast_burn = (
+            sum(v["fast_burn"] * v["fast_events"] for v in group) / fast_n
+            if fast_n else 0.0
+        )
+        slow_burn = (
+            sum(v["slow_burn"] * v["slow_events"] for v in group) / slow_n
+            if slow_n else 0.0
+        )
+        head = group[0]
+        agg = {
+            "spec": key,
+            "objective": head["objective"],
+            "class": head["class"],
+            "target": head["target"],
+            "fast_burn": round(fast_burn, 3),
+            "slow_burn": round(slow_burn, 3),
+            "fast_events": fast_n,
+            "slow_events": slow_n,
+            "burn_threshold": head["burn_threshold"],
+            "replicas": len(group),
+            "alert": bool(
+                fast_n >= 1
+                and fast_burn >= head["burn_threshold"]
+                and slow_burn >= head["burn_threshold"]
+            ),
+        }
+        if "threshold_ms" in head:
+            agg["threshold_ms"] = head["threshold_ms"]
+        out.append(agg)
+    return out

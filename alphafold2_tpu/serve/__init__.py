@@ -5,11 +5,14 @@ See :mod:`alphafold2_tpu.serve.engine` (the synchronous batched engine),
 :mod:`alphafold2_tpu.serve.scheduler` (the async open-loop frontend:
 admission control, deadlines, continuous batch formation),
 :mod:`alphafold2_tpu.serve.cache` (LRU result cache + in-flight dedup),
-:mod:`alphafold2_tpu.serve.faults` (deterministic fault injection) and
+:mod:`alphafold2_tpu.serve.faults` (deterministic fault injection),
 :mod:`alphafold2_tpu.serve.pipeline` (double-buffered host/device dispatch
-pipeline with in-flight batch admission).
+pipeline with in-flight batch admission) and
+:mod:`alphafold2_tpu.serve.fleet` (the multi-replica fleet frontend:
+health-aware routing, work stealing, replica-death draining).
 Configured by ``config.ServeConfig``; benched by ``bench.py --mode serve``
-(closed loop) and ``--mode serve-async`` (open loop, Poisson arrivals).
+(closed loop), ``--mode serve-async`` (open loop, Poisson arrivals) and
+``--mode serve-fleet`` (N replicas behind one router).
 """
 
 from alphafold2_tpu.serve.bucketing import (
@@ -30,7 +33,12 @@ from alphafold2_tpu.serve.cache import (
     result_key,
 )
 from alphafold2_tpu.serve.engine import ServeEngine, ServeRequest, ServeResult
-from alphafold2_tpu.serve.faults import FaultPlan, InjectedFault
+from alphafold2_tpu.serve.faults import (
+    FaultPlan,
+    FleetFaultPlan,
+    InjectedFault,
+)
+from alphafold2_tpu.serve.fleet import FleetFrontend, ReplicaCell
 from alphafold2_tpu.serve.pipeline import (
     DispatchHandle,
     PipelineBatch,
@@ -44,8 +52,11 @@ __all__ = [
     "FamilyTracker",
     "FaultPlan",
     "FeatureCache",
+    "FleetFaultPlan",
+    "FleetFrontend",
     "InjectedFault",
     "PendingResult",
+    "ReplicaCell",
     "PipelineBatch",
     "PipelinedDispatcher",
     "ResultCache",

@@ -47,6 +47,10 @@ class FaultPlan:
 
     fail_dispatch: Optional[int] = None  # 1-based dispatch index to hit
     fail_bucket: Optional[int] = None  # bucket whose dispatches are hit
+    # hit EVERY dispatch regardless of index/bucket — the fleet's replica
+    # degrade drill (match_all + fail=False + delay_s = a uniformly slow
+    # replica the router should route around)
+    match_all: bool = False
     times: int = 1  # max injections (0 = unlimited)
     delay_s: float = 0.0  # sleep before (optionally) failing
     fail: bool = True  # False = delay-only plan
@@ -67,6 +71,8 @@ class FaultPlan:
         self.fired: list = []
 
     def _matches(self, dispatch_index: int, bucket: int) -> bool:
+        if self.match_all:
+            return True
         if self.fail_dispatch is not None and (
             dispatch_index == self.fail_dispatch
         ):
@@ -136,4 +142,86 @@ class FaultPlan:
                 kw["fail_stage"] = value.strip()
             else:
                 raise ValueError(f"unknown fault-spec key {key!r} in {spec!r}")
+        return cls(**kw)
+
+
+@dataclasses.dataclass
+class FleetFaultPlan:
+    """Replica-scoped fleet fault: kill or degrade one replica at a time
+    offset into the run.
+
+    ``replica`` is the target's 0-based index in the fleet; ``at_s`` is
+    seconds from fleet start before the fault becomes due. ``degrade_s``
+    = 0 means a *kill* (the fleet marks the replica dead and drains it:
+    dispatched work completes, queued work re-routes); ``degrade_s`` > 0
+    means a *latency injection* instead — the fleet installs a
+    ``match_all`` delay-only :class:`FaultPlan` on that replica's engine
+    so every one of its dispatches slows by that many seconds, which the
+    load-aware router should route around. The fleet's health pump polls
+    :meth:`take` each tick; ``fired`` records every action for test and
+    bench assertions."""
+
+    replica: int = 0  # 0-based index of the replica to hit
+    at_s: float = 0.0  # seconds from fleet start before the fault is due
+    degrade_s: float = 0.0  # 0 = kill; >0 = per-dispatch latency injection
+    times: int = 1  # max firings (0 = unlimited; kills re-fire inertly)
+    message: str = "injected replica fault"
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+        self.fired: list = []
+
+    @property
+    def kind(self) -> str:
+        return "degrade" if self.degrade_s > 0 else "kill"
+
+    def take(self, elapsed_s: float) -> Optional[str]:
+        """One-shot poll: ``"kill"`` / ``"degrade"`` when the fault is due
+        and its budget remains, else None. Thread-safe; recording and the
+        budget check share one critical section so two pump ticks can't
+        both claim the same firing."""
+        with self._lock:
+            if self.times and len(self.fired) >= self.times:
+                return None
+            if elapsed_s < self.at_s:
+                return None
+            self.fired.append({
+                "replica": self.replica,
+                "elapsed_s": round(elapsed_s, 3),
+                "kind": self.kind,
+            })
+            return self.kind
+
+    def degrade_plan(self) -> FaultPlan:
+        """The engine-side half of a degrade fault: delay every dispatch
+        of the target replica, never fail it."""
+        return FaultPlan(
+            match_all=True, fail=False, delay_s=self.degrade_s, times=0,
+            message=self.message,
+        )
+
+    @classmethod
+    def from_spec(cls, spec: Optional[str]) -> Optional["FleetFaultPlan"]:
+        """Parse ``"replica=1,at_s=2"`` (kill) / ``"replica=0,at_s=1,
+        degrade=0.05"`` (latency) — the ``AF2TPU_SERVE_FLEET_FAULT`` env
+        hook the serve-fleet bench uses for the death drill.
+        None/"" -> None."""
+        if not spec:
+            return None
+        kw: dict = {}
+        for part in spec.split(","):
+            key, _, value = part.partition("=")
+            key = key.strip()
+            if key == "replica":
+                kw["replica"] = int(value)
+            elif key == "at_s":
+                kw["at_s"] = float(value)
+            elif key == "degrade":
+                kw["degrade_s"] = float(value)
+            elif key == "times":
+                kw["times"] = int(value)
+            else:
+                raise ValueError(
+                    f"unknown fleet-fault key {key!r} in {spec!r}"
+                )
         return cls(**kw)

@@ -1,0 +1,735 @@
+"""Multi-replica fleet serving: health-aware router over N serve cells.
+
+One AsyncServeFrontend feeding one ServeEngine is a single serving cell.
+``FleetFrontend`` is the fleet layer production needs on top: it owns N
+replica cells (each a ServeEngine + AsyncServeFrontend + its dispatch
+pipeline, process-local or mesh-slice) and
+
+- **routes** every admitted request to the replica with the lowest load
+  score, fed from per-replica :meth:`~alphafold2_tpu.serve.scheduler.
+  AsyncServeFrontend.load_snapshot` health readings (queued depth,
+  batches in flight, open in-flight formations). A replica holding a
+  joinable in-flight formation for the request's bucket is preferred —
+  continuous batching at the *replica* level: the arrival admits into
+  the partially filled batch (``PipelineBatch.try_join`` via that
+  replica's scheduler) instead of waiting out a fresh fill-or-dwell
+  window anywhere.
+- **steals** queued work from overloaded replicas into idle ones: the
+  health pump evicts the newest, lowest-priority queued requests from
+  the deepest queue (``AsyncServeFrontend.evict_queued``) and re-routes
+  each to the shallowest (``fleet.steals`` / ``fleet.rerouted``).
+- **drains** a dead replica with zero dropped (non-rejected) requests: a
+  kill marks the replica unroutable, its dispatched batches complete and
+  resolve normally, and its non-dispatched queued work resolves as
+  internal "frontend closed" rejections the fleet re-submits to
+  surviving replicas (``fleet.drains``). Replica death and degradation
+  are first-class fault plans (:class:`~alphafold2_tpu.serve.faults.
+  FleetFaultPlan`, ``AF2TPU_SERVE_FLEET_FAULT``), so the death drill is
+  a reproducible scenario, not test-only plumbing.
+
+**Trace continuity across the replica hop**: the router serializes each
+request's context to its W3C ``traceparent()`` header form and the
+replica-side request carries a child reconstructed with
+``TraceContext.from_traceparent`` — one trace spans the router's
+``fleet.admit``/``fleet.route`` events and the replica's full scheduler
+lifecycle, so ``tracectx.trace_completeness`` reconstructs end-to-end
+(the serve-fleet bench gates >= 0.99 across the hop). One
+``AF2TPU_SLO_SPECS`` string fans out to one SLOMonitor per replica, fed
+only caller-visible terminal results (reroute artifacts excluded);
+:func:`~alphafold2_tpu.observe.slo.aggregate_slo_verdicts` rolls the
+per-replica burn into the fleet-level verdict.
+
+**Lock discipline** — the fleet's deadlock cliff, statically enforced by
+the layer-5 concurrency gate: the router NEVER acquires a replica's
+scheduler lock while holding its own. Every FleetFrontend method
+snapshots routing state under ``_lock``, releases, and only then calls
+into a replica frontend (``submit`` / ``evict_queued`` /
+``load_snapshot`` / ``close`` all take ``AsyncServeFrontend._lock``).
+The committed ``concurrency_contracts.json`` lock graph must therefore
+never contain a ``FleetFrontend._lock -> AsyncServeFrontend._lock``
+edge; the gated defect at the bottom of this file proves the gate
+notices one. The reverse direction cannot arise either: replica
+resolution observers run outside the replica's lock by the scheduler's
+own contract, so the fleet may take its router lock inside them.
+
+Env knobs: ``AF2TPU_FLEET_TICK_S`` (health-pump period, default 0.05s)
+and ``AF2TPU_FLEET_STEAL_MARGIN`` (queue-depth gap that triggers a
+steal; 0 = auto from the engine's max_batch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Union
+from urllib.request import urlopen
+
+from alphafold2_tpu.observe import EventCounters, Tracer
+from alphafold2_tpu.observe.exposition import MetricsHTTPServer
+from alphafold2_tpu.observe.slo import SLOMonitor, aggregate_slo_verdicts
+from alphafold2_tpu.observe.tracectx import TraceContext
+from alphafold2_tpu.serve.bucketing import bucket_for
+from alphafold2_tpu.serve.engine import ServeRequest, ServeResult, _as_request
+from alphafold2_tpu.serve.faults import FleetFaultPlan
+from alphafold2_tpu.serve.scheduler import AsyncServeFrontend, PendingResult
+
+# the internal rejection reasons that mean "this replica gave the request
+# back to the router" (steal / drain), never "the fleet turned it away" —
+# the router re-submits these instead of resolving the caller
+STOLEN_ERROR = "stolen by fleet router"
+_REROUTE_ERRORS = (STOLEN_ERROR, "frontend closed")
+
+
+def fleet_counter_zeros(replicas: int) -> dict:
+    """Every fleet counter at zero — merged UNDER live snapshots on the
+    Prometheus exposition so a counter that never fired still exports
+    (absent-at-zero reads as a dead exporter; same fix as the PR-13
+    variant-scan counters)."""
+    zeros = {
+        "fleet.submitted": 0,
+        "fleet.routed": 0,
+        "fleet.rerouted": 0,
+        "fleet.steals": 0,
+        "fleet.drains": 0,
+        "fleet.replica_deaths": 0,
+        "fleet.degraded": 0,
+        "fleet.no_replica": 0,
+        "fleet.resolved": 0,
+        "fleet.resolved_ok": 0,
+        "fleet.pump_errors": 0,
+    }
+    for i in range(replicas):
+        zeros[f"fleet.replica{i}.routed"] = 0
+        zeros[f"fleet.replica{i}.resolved_ok"] = 0
+    return zeros
+
+
+@dataclasses.dataclass
+class ReplicaCell:
+    """One serving cell: an engine plus its async frontend. Immutable
+    after fleet construction (liveness lives in the router's guarded
+    ``_alive`` list, not here, so cell reads need no lock)."""
+
+    index: int
+    engine: object
+    frontend: AsyncServeFrontend
+    metrics: Optional[MetricsHTTPServer] = None
+
+
+@dataclasses.dataclass
+class _Tracked:
+    """Router-side record of one accepted request's journey. Mutable
+    fields (``replica``, ``attempts``) are written under the router
+    lock only."""
+
+    tid: str  # the ROUTER-side trace_id: the tracking key for life
+    handle: PendingResult
+    req: ServeRequest
+    priority: int
+    submitted: float  # router-clock admit timestamp
+    deadline_at: Optional[float]  # absolute router-clock deadline
+    replica: Optional[int] = None
+    attempts: int = 0  # reroutes so far (bounds the retry loop)
+
+
+class FleetFrontend:
+    """Load-aware router over N replica cells.
+
+    >>> fleet = FleetFrontend.build(cfg, replicas=2)
+    >>> handle = fleet.submit("MKTAYIAK...", deadline_s=5.0)
+    >>> result = handle.result(timeout=60)   # structured, never raises
+    >>> fleet.close()
+
+    ``engines`` supplies one (built) engine per replica — share params
+    across them (``FleetFrontend.build`` does) so N replicas initialize
+    once. ``start=False`` skips the replica dispatcher threads AND the
+    health pump; tests then drive :meth:`pump_replicas` /
+    :meth:`pump_health` inline against an injected ``clock``.
+    ``metrics_ports`` (one port per replica, 0 = ephemeral) additionally
+    exposes each replica's ``/metrics`` + ``/healthz`` scrape surface
+    and makes the health pump poll ``/healthz`` for liveness — the
+    telemetry plane as the fleet's health substrate.
+    """
+
+    #: score discount for a replica holding a joinable in-flight
+    #: formation of the request's bucket (fleet-level continuous
+    #: batching: the arrival will ride the partially filled batch)
+    forming_bonus = 0.75
+    #: consecutive failed /healthz polls before a replica is declared
+    #: dead and drained
+    health_strikes_limit = 2
+
+    def __init__(
+        self,
+        engines: Sequence,
+        clock: Optional[Callable[[], float]] = None,
+        tracer: Optional[Tracer] = None,
+        slo_specs: Optional[list] = None,
+        counters: Optional[EventCounters] = None,
+        fault: Optional[FleetFaultPlan] = None,
+        steal_margin: Optional[int] = None,
+        tick_s: Optional[float] = None,
+        max_reroutes: Optional[int] = None,
+        metrics_ports: Optional[Sequence[int]] = None,
+        start: bool = True,
+    ):
+        if not engines:
+            raise ValueError("FleetFrontend needs at least one engine")
+        self._clock = clock if clock is not None else time.perf_counter
+        self.tracer = (
+            tracer if tracer is not None
+            else getattr(engines[0], "tracer", None) or Tracer(enabled=False)
+        )
+        self.counters = counters if counters is not None else EventCounters()
+        self._fault = fault
+        self.tick_s = (
+            float(tick_s) if tick_s is not None
+            else float(os.environ.get("AF2TPU_FLEET_TICK_S", "0.05"))
+        )
+        margin = (
+            int(steal_margin) if steal_margin is not None
+            else int(os.environ.get("AF2TPU_FLEET_STEAL_MARGIN", "0"))
+        )
+        max_batch = max(
+            1, int(getattr(engines[0], "max_batch", 1) or 1)
+        )
+        # auto margin: a gap worth at least two formations before the
+        # router starts moving work around (stealing a single request
+        # just trades one dwell window for another)
+        self.steal_margin = margin if margin > 0 else max(2, 2 * max_batch)
+        self.max_reroutes = (
+            int(max_reroutes) if max_reroutes is not None
+            else 2 * len(engines) + 4
+        )
+        self._cells: List[ReplicaCell] = []
+        self._slo_monitors: List[SLOMonitor] = []
+        for i, engine in enumerate(engines):
+            fe = AsyncServeFrontend(
+                engine, clock=clock, tracer=self.tracer, start=start
+            )
+            server = None
+            if metrics_ports is not None:
+                server = MetricsHTTPServer(
+                    self._make_collect(i, engine, fe),
+                    port=int(metrics_ports[i]),
+                ).start()
+            self._cells.append(ReplicaCell(
+                index=i, engine=engine, frontend=fe, metrics=server,
+            ))
+            fe.add_observer(self._make_on_result(i))
+            if slo_specs:
+                # one monitor per replica from the ONE spec list: the
+                # AF2TPU_SLO_SPECS fan-out. Each gets its own registry so
+                # replica windows never merge; aggregate via slo_summary.
+                self._slo_monitors.append(SLOMonitor(
+                    list(slo_specs), clock=self._clock, tracer=self.tracer,
+                ))
+        self._lock = threading.Lock()
+        self._routed: dict = {}  # router trace_id -> _Tracked
+        self._alive: list = [True] * len(self._cells)
+        self._health_strikes: dict = {}  # replica index -> failed polls
+        self._rr = 0  # round-robin tiebreak cursor
+        self._closing = False
+        self._t0 = self._clock()
+        self._stop_event = threading.Event()
+        self._pump_thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    @classmethod
+    def build(
+        cls,
+        cfg,
+        replicas: int,
+        params=None,
+        checkpoint_dir: Optional[str] = None,
+        mesh=None,
+        **kw,
+    ) -> "FleetFrontend":
+        """Construct ``replicas`` ServeEngines sharing ONE parameter set
+        (replica 0 initializes or loads; the rest alias its params — N
+        replicas never re-initialize N times) and wrap them in a fleet."""
+        from alphafold2_tpu.serve.engine import ServeEngine
+
+        engines: list = []
+        for _ in range(max(1, int(replicas))):
+            engines.append(ServeEngine(
+                cfg,
+                params=params if params is not None else (
+                    engines[0].params if engines else None
+                ),
+                checkpoint_dir=checkpoint_dir if not engines else None,
+                tracer=kw.get("tracer"),
+                mesh=mesh,
+            ))
+        return cls(engines, **kw)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self._pump_thread is not None:
+            return
+        self._pump_thread = threading.Thread(
+            target=self._pump_loop, name="af2-fleet-health", daemon=True
+        )
+        self._pump_thread.start()
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop the health pump, close every live replica (their queued
+        leftovers resolve as structured rejections through the normal
+        observer path), and sweep any handle still tracked."""
+        with self._lock:
+            self._closing = True
+        self._stop_event.set()
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=5.0)
+            self._pump_thread = None
+        with self._lock:
+            alive = [i for i, a in enumerate(self._alive) if a]
+        for i in alive:
+            self._cells[i].frontend.close(timeout=timeout)
+        with self._lock:
+            leftovers = list(self._routed.values())
+            self._routed.clear()
+        for t in leftovers:
+            if not t.handle.done():
+                t.handle._resolve(ServeResult(
+                    seq=t.req.seq, bucket=0, status="rejected",
+                    error="fleet closed",
+                    trace_id=t.req.trace.trace_id if t.req.trace else None,
+                ))
+        for cell in self._cells:
+            if cell.metrics is not None:
+                try:
+                    cell.metrics.stop()
+                except Exception:
+                    pass
+
+    def __enter__(self) -> "FleetFrontend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ telemetry
+
+    def _make_collect(self, index: int, engine, fe: AsyncServeFrontend):
+        def _collect() -> dict:
+            snap = fe.load_snapshot()
+            return {
+                **engine.counters.snapshot(),
+                "sched.depth": snap["depth"],
+                "sched.inflight": snap["inflight"],
+                "replica": index,
+            }
+        return _collect
+
+    def replica_alive(self, index: int) -> bool:
+        with self._lock:
+            return bool(self._alive[index])
+
+    def alive_replicas(self) -> list:
+        with self._lock:
+            return [i for i, a in enumerate(self._alive) if a]
+
+    @property
+    def replicas(self) -> int:
+        return len(self._cells)
+
+    @property
+    def cells(self) -> tuple:
+        return tuple(self._cells)
+
+    @property
+    def depth(self) -> int:
+        return sum(c.frontend.load_snapshot()["depth"] for c in self._cells)
+
+    def stats(self) -> dict:
+        return self.counters.snapshot()
+
+    def snapshot(self) -> dict:
+        """Zero-seeded fleet counters + live per-replica depth/liveness —
+        the exposition collect payload (every counter present from the
+        first scrape, mirroring the PR-13 absent-at-zero fix)."""
+        out = fleet_counter_zeros(len(self._cells))
+        out.update(self.counters.snapshot())
+        for cell in self._cells:
+            snap = cell.frontend.load_snapshot()
+            out[f"fleet.replica{cell.index}.depth"] = snap["depth"]
+            out[f"fleet.replica{cell.index}.inflight"] = snap["inflight"]
+            out[f"fleet.replica{cell.index}.alive"] = int(
+                self.replica_alive(cell.index)
+            )
+        return out
+
+    def histogram_snapshots(self, unit_scale: float = 1.0) -> dict:
+        """Per-replica scheduler histograms, replica-prefixed — drop-in
+        for the bench paths that snapshot a single frontend's."""
+        out: dict = {}
+        for cell in self._cells:
+            for name, snap in cell.frontend.histogram_snapshots(
+                unit_scale
+            ).items():
+                out[f"replica{cell.index}.{name}"] = snap
+        return out
+
+    def slo_summary(self) -> dict:
+        """Per-replica SLO verdicts plus the fleet-aggregated burn (event
+        -weighted across replicas). Empty when no specs were given."""
+        if not self._slo_monitors:
+            return {}
+        per = [m.evaluate() for m in self._slo_monitors]
+        return {
+            "replicas": per,
+            "fleet": aggregate_slo_verdicts(per),
+        }
+
+    # --------------------------------------------------------------- submit
+
+    def submit(
+        self,
+        request: Union[str, ServeRequest],
+        priority: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> PendingResult:
+        """Admit one request and route it; never blocks on a device,
+        never raises for a servable-or-not decision. The returned handle
+        resolves once the request reaches a terminal outcome on SOME
+        replica — internal steal/drain bounces are invisible to the
+        caller beyond latency."""
+        req = _as_request(request)
+        now = self._clock()
+        if priority is None:
+            priority = req.priority
+        if deadline_s is None:
+            deadline_s = req.deadline_s
+        handle = PendingResult(req)
+        tctx = req.trace
+        self.counters.bump("fleet.submitted")
+        # the router half of the cross-replica chain: this event carries
+        # the request's ROOT span, which the replica-side lifecycle
+        # (minted from the traceparent hop) parents onto
+        self.tracer.instant(
+            "fleet.admit",
+            **(tctx.event_args() if tctx is not None else {}),
+        )
+        tracked = _Tracked(
+            tid=tctx.trace_id if tctx is not None else "",
+            handle=handle, req=req, priority=int(priority or 0),
+            submitted=now,
+            deadline_at=(now + deadline_s) if deadline_s else None,
+        )
+        with self._lock:
+            closing = self._closing
+            if not closing:
+                self._routed[tracked.tid] = tracked
+        if closing:
+            handle._resolve(ServeResult(
+                seq=req.seq, bucket=0, status="rejected",
+                error="fleet closed",
+                trace_id=tctx.trace_id if tctx is not None else None,
+            ))
+            return handle
+        self._route(tracked, exclude=None)
+        return handle
+
+    def _route(self, tracked: _Tracked, exclude: Optional[int]) -> None:
+        """Pick a replica and hand the request over. Runs WITHOUT the
+        router lock held (the lock-order rule: replica ``submit`` takes
+        the replica's scheduler lock)."""
+        req = tracked.req
+        now = self._clock()
+        if tracked.deadline_at is not None and now >= tracked.deadline_at:
+            wait = max(0.0, now - tracked.submitted)
+            self._finish(tracked, ServeResult(
+                seq=req.seq, bucket=0, status="deadline_exceeded",
+                error=(
+                    f"deadline passed after {wait:.4g}s "
+                    "(expired while rerouting)"
+                ),
+                latency_s=wait, queue_wait_s=wait,
+            ), replica=None)
+            return
+        try:
+            bucket = bucket_for(
+                len(req.seq), self._cells[0].engine.buckets
+            ) if req.seq else None
+        except ValueError:
+            bucket = None  # unservable: the replica rejects structurally
+        index = self._pick_replica(bucket, exclude)
+        if index is None:
+            self.counters.bump("fleet.no_replica")
+            self._finish(tracked, ServeResult(
+                seq=req.seq, bucket=bucket or 0, status="rejected",
+                error="no alive replicas",
+            ), replica=None)
+            return
+        with self._lock:
+            tracked.replica = index
+        # the hop: W3C header round-trip; the replica-side lifecycle is a
+        # child of the router root, so ONE trace spans both sides
+        hop = (
+            TraceContext.from_traceparent(req.trace.traceparent()).child()
+            if req.trace is not None else None
+        )
+        replica_req = dataclasses.replace(req, trace=hop, arrival_s=None)
+        self.counters.bump("fleet.routed")
+        self.counters.bump(f"fleet.replica{index}.routed")
+        self.tracer.instant(
+            "fleet.route", replica=index,
+            **({"bucket": bucket} if bucket is not None else {}),
+            **(req.trace.child().event_args()
+               if req.trace is not None else {}),
+        )
+        remaining = None
+        if tracked.deadline_at is not None:
+            remaining = max(1e-3, tracked.deadline_at - now)
+        self._cells[index].frontend.submit(
+            replica_req, priority=tracked.priority, deadline_s=remaining
+        )
+
+    def _pick_replica(
+        self, bucket: Optional[int], exclude: Optional[int]
+    ) -> Optional[int]:
+        """Lowest load score wins: queued depth + half the in-flight
+        batches, minus a bonus when the replica holds a joinable
+        formation of this bucket. Round-robin breaks exact ties so an
+        idle fleet stripes instead of piling on replica 0."""
+        with self._lock:
+            alive = [i for i, a in enumerate(self._alive) if a]
+            rr = self._rr
+            self._rr += 1
+        candidates = [i for i in alive if i != exclude] or alive
+        if not candidates:
+            return None
+        n = len(self._cells)
+        best = None
+        for i in candidates:
+            snap = self._cells[i].frontend.load_snapshot()
+            if snap["closed"]:
+                continue
+            score = snap["depth"] + 0.5 * snap["inflight"]
+            if bucket is not None and bucket in snap["forming"]:
+                score -= self.forming_bonus
+            key = (score, (i - rr) % n)
+            if best is None or key < best[0]:
+                best = (key, i)
+        return best[1] if best is not None else None
+
+    # ------------------------------------------------------- result routing
+
+    def _make_on_result(self, index: int):
+        def _on_result(result, priority):
+            self._on_replica_result(index, result, priority)
+        return _on_result
+
+    def _on_replica_result(
+        self, index: int, result: ServeResult, priority: int
+    ) -> None:
+        """Replica resolution hook (runs on replica worker threads,
+        outside the replica's scheduler lock). Internal give-backs
+        (steal / drain / a route that raced a replica close) re-route;
+        everything else is terminal for the caller."""
+        tid = result.trace_id
+        if not tid:
+            return
+        with self._lock:
+            tracked = self._routed.get(tid)
+            if tracked is None:
+                return  # not fleet-routed (or already finished)
+            reroute = (
+                result.status == "rejected"
+                and result.error in _REROUTE_ERRORS
+                and not self._closing
+                and tracked.attempts < self.max_reroutes
+                and any(
+                    a for i, a in enumerate(self._alive) if i != index
+                )
+            )
+            if reroute:
+                tracked.attempts += 1
+        if not reroute:
+            self._finish(tracked, result, replica=index)
+            return
+        self.counters.bump("fleet.rerouted")
+        self.tracer.instant(
+            "fleet.reroute", from_replica=index, reason=result.error,
+            **(tracked.req.trace.child().event_args()
+               if tracked.req.trace is not None else {}),
+        )
+        self._route(tracked, exclude=index)
+
+    def _finish(
+        self, tracked: _Tracked, result: ServeResult,
+        replica: Optional[int],
+    ) -> None:
+        """Terminal resolution: untrack, account, feed the producing
+        replica's SLO monitor, release the caller."""
+        with self._lock:
+            self._routed.pop(tracked.tid, None)
+        self.counters.bump("fleet.resolved")
+        if result.status == "ok":
+            self.counters.bump("fleet.resolved_ok")
+            if replica is not None:
+                self.counters.bump(f"fleet.replica{replica}.resolved_ok")
+        if replica is not None and self._slo_monitors:
+            self._slo_monitors[replica].observe(result, tracked.priority)
+        if tracked.tid and result.trace_id != tracked.tid:
+            result = dataclasses.replace(result, trace_id=tracked.tid)
+        tracked.handle._resolve(result)
+
+    # --------------------------------------------------------------- health
+
+    def kill_replica(
+        self, index: int, reason: str = "killed", timeout: float = 30.0
+    ) -> bool:
+        """Declare a replica dead and drain it: no new routes land on it,
+        its dispatched batches complete and resolve normally, and its
+        non-dispatched queued work resolves as internal rejections that
+        re-route to the survivors — zero accepted requests dropped.
+        Returns False when the replica was already dead."""
+        with self._lock:
+            if not (0 <= index < len(self._cells)) or not self._alive[index]:
+                return False
+            self._alive[index] = False
+        self.counters.bump("fleet.replica_deaths")
+        self.counters.bump("fleet.drains")
+        self.tracer.instant("fleet.drain", replica=index, reason=reason)
+        cell = self._cells[index]
+        cell.frontend.close(timeout=timeout)
+        closer = getattr(cell.engine, "close", None)
+        if closer is not None:
+            try:
+                closer()
+            except Exception:
+                pass
+        if cell.metrics is not None:
+            try:
+                cell.metrics.stop()
+            except Exception:
+                pass
+        return True
+
+    def degrade_replica(self, index: int, delay_s: float) -> None:
+        """Install a delay-only match-all fault plan on one replica's
+        engine: every dispatch there slows by ``delay_s`` — the slow
+        replica the load-aware router (and the steal pass) route
+        around."""
+        from alphafold2_tpu.serve.faults import FaultPlan
+
+        self._cells[index].engine.faults = FaultPlan(
+            match_all=True, fail=False, delay_s=float(delay_s), times=0,
+            message="fleet degrade",
+        )
+        self.counters.bump("fleet.degraded")
+        self.tracer.instant(
+            "fleet.degrade", replica=index, delay_s=float(delay_s)
+        )
+
+    def pump_replicas(self) -> int:
+        """Inline scheduling pass over every live replica (tests with
+        ``start=False`` drive formation deterministically through
+        this). Returns total dispatches executed."""
+        with self._lock:
+            alive = [i for i, a in enumerate(self._alive) if a]
+        return sum(self._cells[i].frontend.pump() for i in alive)
+
+    def pump_health(self) -> dict:
+        """One health pass: fire due replica faults, poll ``/healthz``
+        liveness (when exposed), and run the steal pass. The background
+        pump calls this every ``tick_s``; tests call it inline."""
+        now = self._clock()
+        summary: dict = {"killed": None, "degraded": None, "stolen": 0}
+        fault = self._fault
+        if fault is not None:
+            action = fault.take(now - self._t0)
+            if action == "kill":
+                if self.kill_replica(fault.replica, reason="fault"):
+                    summary["killed"] = fault.replica
+            elif action == "degrade":
+                self.degrade_replica(fault.replica, fault.degrade_s)
+                summary["degraded"] = fault.replica
+        for cell in self._cells:
+            if cell.metrics is None or not self.replica_alive(cell.index):
+                continue
+            healthy = self._poll_healthz(cell)
+            with self._lock:
+                strikes = (
+                    0 if healthy
+                    else self._health_strikes.get(cell.index, 0) + 1
+                )
+                self._health_strikes[cell.index] = strikes
+            if strikes >= self.health_strikes_limit:
+                if self.kill_replica(cell.index, reason="healthz"):
+                    summary["killed"] = cell.index
+        summary["stolen"] = self._steal_pass()
+        return summary
+
+    def _poll_healthz(self, cell: ReplicaCell) -> bool:
+        try:
+            with urlopen(
+                f"http://127.0.0.1:{cell.metrics.port}/healthz",
+                timeout=1.0,
+            ) as resp:
+                return resp.status == 200
+        except Exception:
+            return False
+
+    def _steal_pass(self) -> int:
+        """Move work from the deepest queue to the fleet when the gap to
+        the shallowest exceeds ``steal_margin``: evict the newest,
+        lowest-priority half of the gap; each eviction re-routes through
+        the normal observer path and lands on the least-loaded
+        survivor."""
+        with self._lock:
+            alive = [i for i, a in enumerate(self._alive) if a]
+        if len(alive) < 2:
+            return 0
+        loads = [
+            (i, self._cells[i].frontend.load_snapshot()["depth"])
+            for i in alive
+        ]
+        busy = max(loads, key=lambda t: t[1])
+        idle = min(loads, key=lambda t: t[1])
+        gap = busy[1] - idle[1]
+        if gap <= self.steal_margin:
+            return 0
+        moved = self._cells[busy[0]].frontend.evict_queued(
+            max(1, gap // 2), reason=STOLEN_ERROR
+        )
+        if moved:
+            self.counters.bump("fleet.steals", moved)
+            self.tracer.instant(
+                "fleet.steal", from_replica=busy[0],
+                to_replica=idle[0], n=moved,
+            )
+        return moved
+
+    def _pump_loop(self) -> None:
+        while not self._stop_event.wait(self.tick_s):
+            try:
+                self.pump_health()
+            except Exception:
+                # the health pump must never take the fleet down; the
+                # counter makes a wedged pump visible on the scrape
+                self.counters.bump("fleet.pump_errors")
+
+
+def _audit_fleet_hold_router_lock(  # af2: gated-defect[AF2TPU_AUDIT_FLEET_LOCK]
+    fleet: FleetFrontend, replica: AsyncServeFrontend
+) -> None:
+    """Seeded negative control for the fleet's lock-order rule.
+
+    Never executed: the ``gated-defect`` marker keeps this out of the
+    audit (and out of ``concurrency_contracts.json`` — contract
+    computation always excludes gated defects) unless
+    ``AF2TPU_AUDIT_FLEET_LOCK=1``, in which case the audit-path lock
+    graph gains the FORBIDDEN edge — a replica scheduler lock acquired
+    (via ``submit``) while the router lock is held. CI flips the env var
+    and asserts ``--graph`` surfaces the new ``FleetFrontend._lock ->
+    AsyncServeFrontend._lock`` edge; no thread ever runs this.
+    """
+    with fleet._lock:
+        replica.submit("ACDE")

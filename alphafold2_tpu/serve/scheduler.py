@@ -274,6 +274,52 @@ class AsyncServeFrontend:
         with self._lock:
             return self._depth
 
+    def load_snapshot(self) -> dict:
+        """One consistent routing-grade load reading: queued depth,
+        batches in flight, and the buckets whose in-flight formation is
+        still joinable. The fleet router's health substrate — taken under
+        this frontend's lock so a router never has to hold its OWN lock
+        across the call (the fleet's documented lock-order rule)."""
+        with self._lock:
+            return {
+                "depth": self._depth,
+                "inflight": len(self._inflight),
+                "forming": tuple(self._forming),
+                "closed": self._stop,
+            }
+
+    def evict_queued(self, max_n: int, reason: str = "evicted") -> int:
+        """Pop up to ``max_n`` queued (not yet dispatched) requests —
+        newest, lowest-priority first — and resolve them as structured
+        rejections carrying ``reason``. The fleet router's work-stealing
+        hook: the stolen requests resolve through the normal observer
+        path, so a fleet tracking them by trace_id can re-submit each to
+        another replica. Returns the number evicted."""
+        taken: list = []
+        with self._lock:
+            for bucket in sorted(self._queues, reverse=True):
+                q = self._queues[bucket]
+                while q and len(taken) < max_n:
+                    taken.append(q.pop())  # tail = lowest priority, newest
+                if len(taken) >= max_n:
+                    break
+            self._depth -= len(taken)
+        for p in taken:
+            self.tracer.instant(
+                "sched.evict", bucket=p.bucket, reason=reason,
+                **(p.req.trace.child().event_args()
+                   if p.req.trace is not None else {}),
+            )
+            self._resolve_leader(
+                p,
+                ServeResult(
+                    seq=p.req.seq, bucket=p.bucket, status="rejected",
+                    error=reason,
+                ),
+                cache_ok=False,
+            )
+        return len(taken)
+
     def stats(self) -> dict:
         return self.counters.snapshot()
 
@@ -427,7 +473,7 @@ class AsyncServeFrontend:
 
         # leader: admission control under the scheduler lock
         with self._lock:
-            if self.inflight_admission:
+            if self.inflight_admission and not self._stop:
                 # continuous batching: if this bucket's previous formation
                 # is still in the pipeline's host stage, join it instead of
                 # queueing behind a fresh fill-or-dwell window. No queue
@@ -463,7 +509,13 @@ class AsyncServeFrontend:
                     )
                     return handle
             rejected = None
-            if self._depth >= self.queue_depth:
+            if self._stop:
+                # the dispatcher is gone: a request queued now would hang
+                # forever. A late arrival racing close() — e.g. a fleet
+                # route landing on a replica being drained — gets the
+                # same structured rejection close()'s sweep hands out.
+                rejected = ("frontend closed", "sched.rejected")
+            elif self._depth >= self.queue_depth:
                 rejected = ("queue full", "sched.rejected")
             elif (
                 self.shed_watermark > 0

@@ -2082,13 +2082,29 @@ def bench_serve_replay(emit: bool = True,
     if reason is not None:
         raise RuntimeError(f"recording not replayable: {reason}")
 
-    # ---- replay arm: fresh engine, fresh caches, fresh counters ----
+    # ---- replay arm: fresh engine(s), fresh caches, fresh counters.
+    # AF2TPU_SERVE_REPLAY_FLEET=N replays through an N-replica
+    # FleetFrontend instead of a single cell — the per-cell contract
+    # (byte determinism per (seq, seed), trace completeness across the
+    # hop) must survive fleet routing; the reuse ledger is summed across
+    # cells but its EXACT reproduction is only claimable single-cell
+    # (load-balanced placement legitimately re-splits the feature
+    # caches), so ledger_match stays a 1-replica gate ----
+    fleet_n = max(1, _env_int("AF2TPU_SERVE_REPLAY_FLEET", 1))
     with _bench_stage(tracer, "serve_replay:replay_init"):
-        replay_engine = ServeEngine(
-            _cfg(), params=engine.params, tracer=tracer
-        )
-        replay_engine.warmup()
-    frontend = AsyncServeFrontend(replay_engine, tracer=tracer)
+        replay_engines = [
+            ServeEngine(_cfg(), params=engine.params, tracer=tracer)
+            for _ in range(fleet_n)
+        ]
+        replay_engine = replay_engines[0]
+        for eng in replay_engines:
+            eng.warmup()
+    if fleet_n > 1:
+        from alphafold2_tpu.serve import FleetFrontend
+
+        frontend = FleetFrontend(replay_engines, tracer=tracer)
+    else:
+        frontend = AsyncServeFrontend(replay_engine, tracer=tracer)
     with _bench_stage(tracer, "serve_replay:timed_run"):
         pairs = build_replay(
             submits, time_warp=ra["time_warp"],
@@ -2096,7 +2112,10 @@ def bench_serve_replay(emit: bool = True,
         )
         results, wall = _drive_stream(frontend, pairs)
     frontend.close()
-    stats = replay_engine.counters.snapshot()
+    stats: dict = {}
+    for eng in replay_engines:
+        for k, v in eng.counters.snapshot().items():
+            stats[k] = stats.get(k, 0) + v
     _PHASE["name"] = "serve_replay:record"
 
     ok = [r for r in results if r.status == "ok"]
@@ -2197,9 +2216,14 @@ def bench_serve_replay(emit: bool = True,
             f"warp{ra['time_warp']:g}-scale{ra['load_scale']}"
             + ("-log" if ra["log"] else "")
         )
+    if fleet_n > 1:
+        # comparability variant key, like the serve-fleet records: an
+        # N-cell replay measures a different serving topology
+        record["replicas"] = fleet_n
     # the loop's structural gates: exact reuse-ledger reproduction is
     # only claimable at 1x load (scaled copies are new work by design)
-    if ref_ledger is not None and ra["load_scale"] == 1:
+    # through one cell (fleet placement re-splits the feature caches)
+    if ref_ledger is not None and ra["load_scale"] == 1 and fleet_n == 1:
         record["ledger_match"] = (
             1.0 if replay_ledger == ref_ledger else 0.0
         )
@@ -2264,7 +2288,430 @@ def bench_serve_replay(emit: bool = True,
             if isinstance(v, (int, float, str, bool))
         })
     engine.close()
-    replay_engine.close()
+    for eng in replay_engines:
+        eng.close()
+    if owns_tracer:
+        tracer.close()
+    if emit:
+        _emit(record)
+    return record
+
+
+# ------------------------------------------------------------ serve-fleet ---
+
+
+def _serve_fleet_sizes() -> dict:
+    """The fleet-serving flagship: one open-loop offered stream through N
+    replica cells behind the health-aware router, CPU-mesh sized like the
+    other serve flagships. The arrival rate deliberately exceeds a single
+    replica's capacity so the reference arm saturates and the N-replica
+    goodput ratio measures real horizontal scaling, not idle slack.
+    AF2TPU_SERVE_FLEET_* knobs rescale it — any of them set marks the
+    record non-flagship (never baseline-compared)."""
+    buckets = tuple(
+        int(v) for v in os.environ.get(
+            "AF2TPU_SERVE_FLEET_BUCKETS", "12,16"
+        ).split(",") if v
+    )
+    return {
+        "replicas": _env_int("AF2TPU_SERVE_FLEET_REPLICAS", 2),
+        "buckets": buckets,
+        "max_batch": _env_int("AF2TPU_SERVE_FLEET_MAX_BATCH", 2),
+        "requests": _env_int("AF2TPU_SERVE_FLEET_REQUESTS", 48),
+        "rate": float(os.environ.get("AF2TPU_SERVE_FLEET_RATE", 200.0)),
+        "dup_fraction": 0.1,  # workload definition: repeat-sequence share
+        "dim": _env_int("AF2TPU_SERVE_FLEET_DIM", 32),
+        "depth": _env_int("AF2TPU_SERVE_FLEET_DEPTH", 1),
+        "heads": _env_int("AF2TPU_SERVE_FLEET_HEADS", 2),
+        "dim_head": _env_int("AF2TPU_SERVE_FLEET_DIM_HEAD", 16),
+        "msa_depth": _env_int("AF2TPU_SERVE_FLEET_MSA_DEPTH", 2),
+        "mds_iters": _env_int("AF2TPU_SERVE_FLEET_MDS_ITERS", 20),
+        "dwell_ms": float(
+            os.environ.get("AF2TPU_SERVE_FLEET_DWELL_MS", 10.0)
+        ),
+        # deep enough that the saturating backlog is queued, not shed:
+        # admission rejections would pollute the goodput ratio
+        "queue_depth": _env_int("AF2TPU_SERVE_FLEET_QUEUE_DEPTH", 96),
+        "deadline_s": float(
+            os.environ.get("AF2TPU_SERVE_FLEET_DEADLINE_S", 120.0)
+        ),
+        "seed": _env_int("AF2TPU_SERVE_FLEET_SEED", 0),
+        # replica fault spec for the drill arm ("replica=1,at_s=2" kill /
+        # "degrade=0.05" latency); empty = the built-in mid-run kill
+        "fault": os.environ.get("AF2TPU_SERVE_FLEET_FAULT", ""),
+    }
+
+
+def fleet_config_overridden() -> bool:
+    return any(k.startswith("AF2TPU_SERVE_FLEET_") for k in os.environ)
+
+
+def _serve_fleet_metric(s: dict) -> str:
+    return (
+        f"serve-fleet residues/sec replicas={s['replicas']} "
+        f"buckets={','.join(map(str, s['buckets']))} "
+        f"max_batch={s['max_batch']} requests={s['requests']} "
+        f"rate={s['rate']:g}/s dup={s['dup_fraction']:g} dim={s['dim']} "
+        f"depth={s['depth']} msa_depth={s['msa_depth']} "
+        f"mds_iters={s['mds_iters']} dwell_ms={s['dwell_ms']:g} "
+        f"queue={s['queue_depth']}"
+    )
+
+
+def _drive_fleet_stream(frontend, pairs, timeout: float = 240.0) -> tuple:
+    """Open-loop submission like :func:`_drive_stream`, but an unresolved
+    handle is COUNTED instead of raising — the zero-silent-drops claim is
+    the measurement, so a dropped request must surface as a number, not a
+    bench crash. Returns (results-with-None-for-unresolved, wall_s,
+    unresolved_count)."""
+    t0 = time.perf_counter()
+    handles = []
+    for off, req in pairs:
+        delay = t0 + off - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        handles.append(frontend.submit(req))
+    results: list = []
+    unresolved = 0
+    for h in handles:
+        try:
+            results.append(h.result(timeout=timeout))
+        except TimeoutError:
+            unresolved += 1
+            results.append(None)
+    return results, time.perf_counter() - t0, unresolved
+
+
+def bench_serve_fleet(emit: bool = True, tracer: Tracer | None = None) -> dict:
+    """Multi-replica fleet bench: horizontal goodput scaling and the
+    replica-death drill, in one record.
+
+    Three arms over the SAME deterministic offered stream (seeded request
+    list + Poisson arrival offsets, re-minted per arm so every arm owns
+    fresh trace identities), all on engines sharing ONE parameter set:
+
+    - **reference arm**: a 1-replica ``FleetFrontend`` — router overhead
+      included, so the speedup ratio isolates horizontal scaling.
+    - **fleet arm**: the N-replica fleet. ``fleet_speedup`` = fleet
+      goodput / reference goodput, gated >= 1.6 at 2 replicas
+      (FLEET_THRESHOLDS, observe/regress.py).
+    - **drill arm**: the N-replica fleet with a mid-run replica kill
+      (``AF2TPU_SERVE_FLEET_FAULT`` spec, or a built-in kill of the last
+      replica at 40% of the fleet arm's wall). The claim is structural:
+      every accepted request resolves to a terminal ServeResult
+      (``accepted_unresolved`` == 0 — queued work on the dead replica
+      re-routes to survivors, dispatched work completes), and trace
+      reconstruction stays >= 99% ACROSS the router→replica traceparent
+      hop, kill included.
+
+    The record carries ``replicas`` always — it is a comparability
+    variant key, so a 2-replica number never ratios a 4-replica
+    baseline."""
+    import numpy as np
+
+    from alphafold2_tpu.config import (
+        Config, DataConfig, ModelConfig, ServeConfig,
+    )
+    from alphafold2_tpu.observe import Histogram
+    from alphafold2_tpu.observe.slo import (
+        default_serve_slos, parse_slo_specs,
+    )
+    from alphafold2_tpu.observe.tracectx import trace_completeness
+    from alphafold2_tpu.serve import FleetFaultPlan, ServeEngine, ServeRequest
+    from alphafold2_tpu.serve.fleet import FleetFrontend, fleet_counter_zeros
+
+    owns_tracer = tracer is None
+    tracer = tracer if tracer is not None else _tracer()
+    if not tracer.enabled:
+        # the cross-hop trace-reconstruction gate needs live events even
+        # when no trace file was requested
+        tracer = Tracer(enabled=True)
+        owns_tracer = True
+    s = _serve_fleet_sizes()
+    n_replicas = max(1, s["replicas"])
+
+    with _bench_stage(tracer, "serve_fleet:backend_init"):
+        cfg = Config(
+            model=ModelConfig(
+                dim=s["dim"], depth=s["depth"], heads=s["heads"],
+                dim_head=s["dim_head"], max_seq_len=3 * s["buckets"][-1],
+                bfloat16=jax.devices()[0].platform != "cpu",
+            ),
+            data=DataConfig(msa_depth=s["msa_depth"]),
+            serve=ServeConfig(
+                buckets=s["buckets"], max_batch=s["max_batch"],
+                mds_iters=s["mds_iters"], dwell_ms=s["dwell_ms"],
+                queue_depth=s["queue_depth"], shed_watermark=0.0,
+                default_deadline_s=s["deadline_s"],
+            ),
+        )
+        # one parameter set across the whole fleet: replica 0 initializes,
+        # the rest alias (N replicas never re-initialize N times)
+        engines = []
+        for _ in range(n_replicas):
+            engines.append(ServeEngine(
+                cfg,
+                params=engines[0].params if engines else None,
+                tracer=tracer,
+            ))
+    with _bench_stage(tracer, "serve_fleet:trace_compile"):
+        t0 = time.perf_counter()
+        for eng in engines:
+            eng.warmup()
+        compile_s = time.perf_counter() - t0
+
+    # the deterministic offered stream, shared by every arm: same (seq,
+    # seed) list, same Poisson arrival offsets; each arm re-mints fresh
+    # ServeRequest objects so its lifecycles own their trace identities
+    rng = np.random.default_rng(s["seed"])
+    lo = max(4, s["buckets"][0] // 2)
+    alpha = "ACDEFGHIKLMNPQRSTVWY"
+    spec: list = []  # [(seq, seed)]
+    for i in range(s["requests"]):
+        if spec and rng.random() < s["dup_fraction"]:
+            spec.append(spec[int(rng.integers(0, len(spec)))])
+        else:
+            n = int(rng.integers(lo, s["buckets"][-1] + 1))
+            spec.append((
+                "".join(rng.choice(list(alpha), size=n)), i,
+            ))
+    offsets = np.cumsum(rng.exponential(1.0 / s["rate"], size=s["requests"]))
+
+    def make_pairs() -> list:
+        return [
+            (float(off), ServeRequest(seq=q, seed=sd))
+            for off, (q, sd) in zip(offsets, spec)
+        ]
+
+    slo_specs = parse_slo_specs(
+        os.environ.get("AF2TPU_SLO_SPECS", "")
+    ) or default_serve_slos(s["deadline_s"])
+
+    # Prometheus exposition with every fleet counter zero-seeded from the
+    # first scrape (the PR-13 absent-at-zero fix, fleet edition): the
+    # collect closure reads whichever arm's fleet is live right now
+    current: dict = {"fleet": None}
+    metrics_server = exposition.serve_from_env(
+        lambda: {
+            **fleet_counter_zeros(n_replicas),
+            **(
+                current["fleet"].snapshot()
+                if current["fleet"] is not None else {}
+            ),
+        }
+    )
+
+    def run_arm(arm_engines, fault=None, specs=None, stage="timed_run"):
+        fleet = FleetFrontend(
+            arm_engines, tracer=tracer, fault=fault, slo_specs=specs,
+        )
+        current["fleet"] = fleet
+        try:
+            with _bench_stage(tracer, f"serve_fleet:{stage}"):
+                results, wall, unresolved = _drive_fleet_stream(
+                    fleet, make_pairs()
+                )
+            snap = fleet.snapshot()
+            slo = fleet.slo_summary()
+        finally:
+            fleet.close()
+        resolved = [r for r in results if r is not None]
+        ok = [r for r in resolved if r.status == "ok"]
+        lat = Histogram()
+        for r in ok:
+            lat.observe(r.latency_s)
+        lat_ms = lat.snapshot(unit_scale=1e3, digits=4) if ok else {"count": 0}
+        completeness = trace_completeness(
+            tracer.events(),
+            [r.trace_id for r in resolved
+             if r.status != "rejected" and r.trace_id],
+        )
+        return {
+            "results": results,
+            "ok": ok,
+            "wall": wall,
+            "unresolved": unresolved,
+            "rejected": sum(
+                1 for r in resolved if r.status == "rejected"
+            ),
+            "errors": sum(1 for r in resolved if r.status == "error"),
+            "deadline_misses": sum(
+                1 for r in resolved if r.status == "deadline_exceeded"
+            ),
+            "goodput_rps": round(len(ok) / wall, 3) if wall > 0 else 0.0,
+            "residues_per_s": (
+                round(sum(len(r.seq) for r in ok) / wall, 1)
+                if wall > 0 else 0.0
+            ),
+            "lat_ms": lat_ms,
+            "counters": snap,
+            "slo": slo,
+            "trace": completeness,
+        }
+
+    # reference arm: ONE replica behind the same router (overhead-equal)
+    ref = run_arm(engines[:1], stage="timed_ref")
+    # fleet arm: all N replicas, same offered stream
+    fleet_arm = run_arm(
+        engines, specs=slo_specs, stage="timed_fleet"
+    )
+    # drill arm: the same fleet with a mid-run replica kill. The built-in
+    # default kills the LAST replica at 40% of the fleet arm's wall —
+    # mid-backlog by construction, whatever this host's speed
+    fault = FleetFaultPlan.from_spec(s["fault"]) or FleetFaultPlan(
+        replica=n_replicas - 1,
+        at_s=max(0.2, 0.4 * fleet_arm["wall"]),
+    )
+    drill = run_arm(engines, fault=fault, stage="timed_drill")
+    _PHASE["name"] = "serve_fleet:record"
+
+    speedup = (
+        fleet_arm["goodput_rps"] / ref["goodput_rps"]
+        if ref["goodput_rps"] else 0.0
+    )
+    # the cross-hop reconstruction claim covers the drill too: a kill must
+    # not orphan lifecycles
+    trace_fraction = min(
+        fleet_arm["trace"]["fraction"], drill["trace"]["fraction"]
+    )
+    unresolved_total = (
+        ref["unresolved"] + fleet_arm["unresolved"] + drill["unresolved"]
+    )
+    fleet_counters = fleet_arm["counters"]
+    drill_counters = drill["counters"]
+
+    record = {
+        "metric": _serve_fleet_metric(s),
+        "value": fleet_arm["residues_per_s"],
+        "unit": "residues/sec",
+        "mode": "serve-fleet",
+        # ALWAYS carried: the comparability variant key fencing records
+        # with different fleet widths from each other
+        "replicas": n_replicas,
+        "p50_ms": round(fleet_arm["lat_ms"].get("p50", 0.0), 1),
+        "p95_ms": round(fleet_arm["lat_ms"].get("p95", 0.0), 1),
+        "p99_ms": round(fleet_arm["lat_ms"].get("p99", 0.0), 1),
+        "goodput_rps": fleet_arm["goodput_rps"],
+        "ref_goodput_rps": ref["goodput_rps"],
+        "fleet_speedup": round(speedup, 3),
+        # replica dispatchers are OS threads: a single-core host cannot
+        # express N-replica parallelism, so the regression gate applies
+        # the fleet_speedup floor only where host_cpus >= 2
+        "host_cpus": os.cpu_count() or 1,
+        "requests": s["requests"],
+        "completed": len(fleet_arm["ok"]),
+        "rejected": fleet_arm["rejected"],
+        "deadline_misses": fleet_arm["deadline_misses"],
+        "dispatch_error_results": fleet_arm["errors"],
+        # the structural gates: every accepted request reaches a terminal
+        # result, in every arm, kill included
+        "accepted_unresolved": drill["unresolved"],
+        "dropped_requests": unresolved_total,
+        "trace_complete_fraction": trace_fraction,
+        "trace": {
+            "fleet": fleet_arm["trace"],
+            "drill": drill["trace"],
+        },
+        "fleet_counters": {
+            k: v for k, v in sorted(fleet_counters.items())
+            if k.startswith("fleet.")
+        },
+        "drill": {
+            "fault": {
+                "replica": fault.replica,
+                "kind": fault.kind,
+                "at_s": round(fault.at_s, 3),
+                "fired": fault.fired,
+            },
+            "requests": s["requests"],
+            "completed": len(drill["ok"]),
+            "rejected": drill["rejected"],
+            "unresolved": drill["unresolved"],
+            "goodput_rps": drill["goodput_rps"],
+            "rerouted": drill_counters.get("fleet.rerouted", 0),
+            "steals": drill_counters.get("fleet.steals", 0),
+            "drains": drill_counters.get("fleet.drains", 0),
+            "replica_deaths": drill_counters.get(
+                "fleet.replica_deaths", 0
+            ),
+        },
+        "steals": fleet_counters.get("fleet.steals", 0),
+        "rerouted": fleet_counters.get("fleet.rerouted", 0),
+        "slo": fleet_arm["slo"],
+        "compiles": sum(
+            eng.counters.snapshot().get("serve.compiles", 0)
+            for eng in engines
+        ),
+        "compile_s": round(compile_s, 1),
+        "device": jax.devices()[0].device_kind,
+        "pipeline": engines[0].pipeline_desc,
+    }
+    # per-replica goodput, flat beside the nested counters: the scrape
+    # and obs_report's occupancy table address these by name
+    for i in range(n_replicas):
+        record[f"goodput_requests_replica{i}"] = fleet_counters.get(
+            f"fleet.replica{i}.resolved_ok", 0
+        )
+    if _CLOCK["probe"] is not None:
+        record["clock_probe"] = _CLOCK["probe"]
+        if not _CLOCK["probe"]["ok"]:
+            record["clock_suspect"] = True
+
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "bench_serve_fleet_baseline.json",
+    )
+    vs, compared = 1.0, False
+    if (
+        os.path.exists(baseline_path)
+        and not fleet_config_overridden()
+        and not record.get("clock_suspect")
+    ):
+        with open(baseline_path) as f:
+            base = json.load(f)
+        if (
+            base.get("value")
+            and base.get("metric") == record["metric"]
+            and base.get("device") == record["device"]
+            and base.get("pipeline") == record.get("pipeline")
+            # different fleet widths are different measurements
+            and base.get("replicas") == record.get("replicas")
+        ):
+            vs = record["value"] / base["value"]
+            compared = True
+    record["vs_baseline"] = round(vs, 3)
+    record["vs_baseline_valid"] = compared and not record.get("clock_suspect")
+    if record.get("clock_suspect"):
+        record["vs_baseline"] = 0.0
+
+    if (
+        os.environ.get("AF2TPU_SERVE_RECORD_BASELINE") == "1"
+        and not fleet_config_overridden()
+        and not record.get("clock_suspect")
+    ):
+        with open(baseline_path, "w") as f:
+            json.dump(record, f, indent=2)
+        print(
+            f"recorded serve-fleet baseline -> {baseline_path}",
+            file=sys.stderr,
+        )
+
+    logger = _metrics_logger()
+    if logger is not None:
+        logger.log(0, record["fleet_counters"])
+        logger.log(0, {
+            k: v for k, v in record.items()
+            if isinstance(v, (int, float, str, bool))
+        })
+    for eng in engines:
+        closer = getattr(eng, "close", None)
+        if closer is not None:
+            try:
+                closer()
+            except Exception:
+                pass
+    if metrics_server is not None:
+        metrics_server.stop()
     if owns_tracer:
         tracer.close()
     if emit:
@@ -2477,8 +2924,10 @@ def bench_mode(argv=None) -> str:
     (closed-loop batched engine), 'serve-async' (open-loop frontend),
     'serve-scan' (variant-scan fast lane vs cold path), 'serve-replay'
     (workload record→replay roundtrip; also takes ``--time-warp``,
-    ``--load-scale`` and ``--replay-log``) or 'kernels' (fused-vs-stock
-    attention microbench).
+    ``--load-scale`` and ``--replay-log``; ``AF2TPU_SERVE_REPLAY_FLEET=N``
+    replays against an N-replica fleet), 'serve-fleet' (N replica cells
+    behind the health-aware router: scaling + replica-death drill) or
+    'kernels' (fused-vs-stock attention microbench).
     Spelled ``--mode serve`` / ``--mode=serve-async`` or AF2TPU_BENCH_MODE."""
     args = sys.argv[1:] if argv is None else argv
     for i, a in enumerate(args):
@@ -2696,7 +3145,7 @@ if __name__ == "__main__":
 
     _mode = bench_mode()
     if _mode in ("serve", "serve-async", "serve-scan", "serve-replay",
-                 "kernels"):
+                 "serve-fleet", "kernels"):
         # the serve/kernels benches run wherever the engine runs (the CPU
         # mesh included — that is the point: valid perf numbers without the
         # tunnel); no preflight, no first-light, same watchdog + one-JSON-
@@ -2707,6 +3156,7 @@ if __name__ == "__main__":
                 "serve-async": bench_serve_async,
                 "serve-scan": bench_serve_scan,
                 "serve-replay": bench_serve_replay,
+                "serve-fleet": bench_serve_fleet,
                 "kernels": bench_kernels,
             }[_mode]()
             sys.exit(0)

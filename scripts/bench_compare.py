@@ -78,6 +78,7 @@ def default_baseline_path(record: dict) -> str:
             "serve": "bench_serve_baseline.json",
             "serve-async": "bench_serve_async_baseline.json",
             "serve-scan": "bench_serve_scan_baseline.json",
+            "serve-fleet": "bench_serve_fleet_baseline.json",
             "kernels": "bench_kernels_baseline.json",
         }.get(record.get("mode"), "bench_baseline.json")
     return os.path.join(REPO, name)

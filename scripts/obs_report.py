@@ -76,6 +76,11 @@ def classify(path: str) -> str:
         if (isinstance(doc, dict) and "format" in doc
                 and isinstance(doc.get("lock_graph"), dict)):
             return "concurrency-contracts"
+        if isinstance(doc, dict):
+            # a single-record metrics file (e.g. one bench JSON line
+            # longer than the sniff window) parses whole even when its
+            # first 4096 bytes don't
+            return "trace" if "ph" in doc else "metrics"
     first = head.splitlines()[0] if head else "{}"
     try:
         rec = json.loads(first)
@@ -91,6 +96,7 @@ def report_trace(path: str) -> list:
     spans = [e for e in events if e.get("ph") == "X"]
     print(f"== trace {path}: {len(events)} events, {len(spans)} spans ==")
     if not spans:
+        report_fleet_timeline(events)
         report_request_traces(events)
         return errors
     by_name: dict = {}
@@ -123,6 +129,7 @@ def report_trace(path: str) -> list:
             shape = ", ".join(f"{k}={v}" for k, v in sorted(args.items()))
             print(f"  {e['name']}({shape}): {_fmt_s(e.get('dur', 0) / 1e6)}")
     report_pipeline(events)
+    report_fleet_timeline(events)
     report_request_traces(events)
     return errors
 
@@ -468,6 +475,99 @@ def report_replay(latest: dict) -> None:
         print(f"  recording:       {latest['workload_log']}")
 
 
+def report_fleet(latest: dict) -> None:
+    """Fleet-serving section: printed when a ``--mode serve-fleet`` bench
+    record (or a metrics file carrying ``fleet.*`` counters) rode the
+    file. Shows the per-replica goodput/occupancy table, the steal /
+    drain / reroute accounting, the death-drill outcome (the zero-drop
+    contract the CI gate judges absolutely) and the cross-replica trace
+    verdict — one trace per request spanning the router hop."""
+    counters = latest.get("fleet_counters") or {
+        k: v for k, v in latest.items() if k.startswith("fleet.")
+    }
+    if latest.get("mode") != "serve-fleet" and not counters:
+        return
+    n = int(latest.get("replicas") or 0)
+    speed = latest.get("fleet_speedup")
+    head = f"{n} replica(s)" if n else "counters only"
+    if speed is not None:
+        head += (f", {speed}x goodput vs the 1-replica reference "
+                 f"({latest.get('goodput_rps')} vs "
+                 f"{latest.get('ref_goodput_rps')} req/s)")
+    print(f"-- fleet serving ({head}) --")
+    if n:
+        print(f"  {'replica':<9} {'routed':>8} {'resolved ok':>12} "
+              f"{'goodput req':>12}")
+        for i in range(n):
+            routed = counters.get(f"fleet.replica{i}.routed", 0)
+            ok = counters.get(f"fleet.replica{i}.resolved_ok", 0)
+            good = latest.get(f"goodput_requests_replica{i}", ok)
+            print(f"  {i:<9} {int(routed):>8} {int(ok):>12} "
+                  f"{int(good):>12}")
+    moved = counters.get("fleet.steals", 0)
+    rerouted = counters.get("fleet.rerouted", 0)
+    drains = counters.get("fleet.drains", 0)
+    print(f"  rebalancing:    {int(moved)} stolen, {int(rerouted)} "
+          f"rerouted, {int(drains)} drain(s), "
+          f"{int(counters.get('fleet.no_replica', 0))} with no live "
+          f"replica")
+    drill = latest.get("drill") or {}
+    if drill:
+        fault = drill.get("fault") or {}
+        fired = "fired" if fault.get("fired") else "NOT FIRED"
+        unresolved = drill.get("unresolved", 0)
+        verdict = ("ZERO DROPPED" if not unresolved
+                   else f"{int(unresolved)} UNRESOLVED")
+        print(f"  death drill:    {fault.get('kind', '?')} replica "
+              f"{fault.get('replica', '?')} at {fault.get('at_s', '?')}s "
+              f"({fired}): {drill.get('completed', 0)}/"
+              f"{drill.get('requests', 0)} completed, "
+              f"{int(drill.get('rerouted', 0))} rerouted -> {verdict}")
+    frac = latest.get("trace_complete_fraction")
+    if frac is not None:
+        print(f"  hop traces:     {frac:.1%} reconstruct end-to-end "
+              f"across the router->replica hop")
+
+
+_FLEET_EVENT_NAMES = ("fleet.steal", "fleet.drain", "fleet.degrade",
+                      "fleet.reroute")
+
+
+def report_fleet_timeline(events: list, max_shown: int = 20) -> None:
+    """Steal/drain timeline from the router's instant events: what the
+    health pump did and when, relative to the first fleet admission."""
+    acts = [e for e in events if e.get("name") in _FLEET_EVENT_NAMES]
+    if not acts:
+        return
+    admits = [e.get("ts", 0) for e in events
+              if e.get("name") == "fleet.admit"]
+    t0 = min(admits) if admits else min(e.get("ts", 0) for e in acts)
+    reroutes = sum(1 for e in acts if e.get("name") == "fleet.reroute")
+    print(f"-- fleet timeline ({len(acts)} router action(s), "
+          f"{reroutes} reroute(s)) --")
+    shown = 0
+    for e in sorted(acts, key=lambda e: e.get("ts", 0)):
+        if e.get("name") == "fleet.reroute":
+            continue  # per-request noise; counted in the header
+        if shown >= max_shown:
+            print("  ...")
+            break
+        shown += 1
+        args = e.get("args") or {}
+        at = (e.get("ts", 0) - t0) / 1e6
+        if e["name"] == "fleet.steal":
+            detail = (f"moved {args.get('n')} request(s) replica "
+                      f"{args.get('from_replica')} -> "
+                      f"{args.get('to_replica')}")
+        elif e["name"] == "fleet.drain":
+            detail = (f"replica {args.get('replica')} drained "
+                      f"({args.get('reason', '?')})")
+        else:
+            detail = (f"replica {args.get('replica')} degraded "
+                      f"+{args.get('delay_s')}s/dispatch")
+        print(f"  +{at:8.3f}s  {e['name']:<13} {detail}")
+
+
 def report_kernels(latest: dict) -> None:
     """Kernels/precision section: printed when records carry the kernel-
     policy or serving-dtype keys (ops/kernels.py KernelPolicy, serve.dtype)
@@ -698,6 +798,7 @@ def report_metrics(path: str) -> list:
     report_scheduler(latest)
     report_variant_scan(latest)
     report_replay(latest)
+    report_fleet(latest)
     report_slo(latest)
     report_mesh(latest)
     report_kernels(latest)

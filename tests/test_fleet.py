@@ -1,0 +1,407 @@
+"""Fleet frontend tests (serve/fleet.py + the scheduler/faults hooks).
+
+The router logic is tested deterministically like the scheduler's: fake
+engines, a fake clock, ``start=False`` (no replica dispatcher threads,
+no health pump) and inline ``pump_replicas()`` / ``pump_health()``
+passes. Covered: load-aware routing, work stealing with observer-driven
+re-routing, replica-death draining with zero dropped accepted requests,
+trace reconstruction across the traceparent hop, replica-scoped fault
+plans, SLO fan-out aggregation, and the fleet's lock-order rule as a
+static assertion over the layer-5 concurrency model."""
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu.config import (
+    Config,
+    DataConfig,
+    ModelConfig,
+    ServeConfig,
+)
+from alphafold2_tpu.observe import EventCounters, Tracer
+from alphafold2_tpu.observe.slo import aggregate_slo_verdicts
+from alphafold2_tpu.observe.tracectx import trace_completeness
+from alphafold2_tpu.serve import FaultPlan, FleetFaultPlan, ServeResult
+from alphafold2_tpu.serve.fleet import (
+    STOLEN_ERROR,
+    FleetFrontend,
+    fleet_counter_zeros,
+)
+
+
+def _cfg(buckets=(8, 16), max_batch=2, **serve_kw):
+    serve_kw.setdefault("mds_iters", 10)
+    serve_kw.setdefault("dwell_ms", 50.0)
+    return Config(
+        model=ModelConfig(dim=32, depth=1, heads=2, dim_head=16,
+                          max_seq_len=3 * max(buckets), bfloat16=False),
+        data=DataConfig(msa_depth=2),
+        serve=ServeConfig(buckets=buckets, max_batch=max_batch, **serve_kw),
+    )
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+class FakeEngine:
+    """Engine stand-in mirroring tests/test_scheduler.py's: records every
+    dispatch, never touches jax."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.buckets = cfg.serve.buckets
+        self.max_batch = cfg.serve.max_batch
+        self.mesh_desc = None
+        self.counters = EventCounters()
+        self.tracer = Tracer(enabled=False)
+        self.dispatched = []
+
+    def batch_for(self, bucket):
+        return self.max_batch
+
+    def dispatch_batch(self, bucket, reqs):
+        self.dispatched.append((bucket, [r.seq for r in reqs]))
+        return [
+            ServeResult(
+                seq=r.seq, bucket=bucket,
+                atom14=np.zeros((len(r.seq), 14, 3), np.float32),
+                latency_s=1e-3,
+            )
+            for r in reqs
+        ]
+
+    def retry_bucket(self, bucket):
+        i = self.buckets.index(bucket)
+        return self.buckets[i + 1] if i + 1 < len(self.buckets) else None
+
+
+def _fleet(replicas=2, tracer=None, **kw):
+    cfg = _cfg()
+    engines = [FakeEngine(cfg) for _ in range(replicas)]
+    clock = FakeClock()
+    fleet = FleetFrontend(
+        engines, clock=clock, tracer=tracer, start=False, **kw
+    )
+    return fleet, engines, clock
+
+
+def _seqs(n, length=6):
+    alpha = "ACDEFGHIKLMNPQRSTVWY"
+    return [
+        "".join(alpha[(i + j) % len(alpha)] for j in range(length))
+        for i in range(n)
+    ]
+
+
+def _drain(fleet, clock, rounds=10):
+    # advance past the dwell window each round so partial batches dispatch
+    for _ in range(rounds):
+        clock.advance(1.0)
+        if fleet.pump_replicas() == 0 and fleet.depth == 0:
+            break
+
+
+# ---------------------------------------------------------------- routing
+
+
+def test_routing_stripes_idle_fleet():
+    fleet, engines, clock = _fleet(replicas=2)
+    handles = [fleet.submit(q, deadline_s=None) for q in _seqs(4)]
+    # both replicas got work: an idle fleet stripes round-robin instead
+    # of piling everything on replica 0
+    depths = [c.frontend.depth for c in fleet.cells]
+    assert depths == [2, 2]
+    _drain(fleet, clock)
+    results = [h.result(0) for h in handles]
+    assert all(r.status == "ok" for r in results)
+    assert fleet.stats()["fleet.routed"] == 4
+    fleet.close()
+
+
+def test_routing_prefers_less_loaded_replica():
+    fleet, engines, _ = _fleet(replicas=2)
+    # preload replica 0 via the router by pinning the pick, then restore
+    orig = fleet._pick_replica
+    fleet._pick_replica = lambda bucket, exclude: 0
+    for q in _seqs(3):
+        fleet.submit(q)
+    fleet._pick_replica = orig
+    fleet.submit("MKVLITAA")  # load-aware: must land on empty replica 1
+    assert fleet.cells[1].frontend.depth == 1
+    fleet.close()
+
+
+def test_result_carries_router_trace_id():
+    fleet, _, clock = _fleet(replicas=2)
+    h = fleet.submit("ACDEFG")
+    root_tid = h.request.trace.trace_id
+    _drain(fleet, clock)
+    assert h.result(0).trace_id == root_tid
+    fleet.close()
+
+
+# --------------------------------------------------------------- stealing
+
+
+def test_steal_rebalances_and_reroutes():
+    fleet, engines, clock = _fleet(replicas=2)
+    orig = fleet._pick_replica
+    fleet._pick_replica = lambda bucket, exclude: 0  # force imbalance
+    handles = [fleet.submit(q) for q in _seqs(8)]
+    fleet._pick_replica = orig
+    assert [c.frontend.depth for c in fleet.cells] == [8, 0]
+    # gap 8 > auto margin max(2, 2*max_batch)=4: steal half the gap
+    summary = fleet.pump_health()
+    assert summary["stolen"] == 4
+    stats = fleet.stats()
+    assert stats["fleet.steals"] == 4
+    assert stats["fleet.rerouted"] == 4
+    assert [c.frontend.depth for c in fleet.cells] == [4, 4]
+    _drain(fleet, clock)
+    results = [h.result(0) for h in handles]
+    assert all(r.status == "ok" for r in results)
+    # the steal is invisible to callers: no STOLEN_ERROR ever escapes
+    assert not any(r.error == STOLEN_ERROR for r in results)
+    fleet.close()
+
+
+def test_steal_needs_margin():
+    fleet, engines, _ = _fleet(replicas=2)
+    orig = fleet._pick_replica
+    fleet._pick_replica = lambda bucket, exclude: 0
+    for q in _seqs(3):
+        fleet.submit(q)
+    fleet._pick_replica = orig
+    assert fleet.pump_health()["stolen"] == 0  # gap 3 <= margin 4
+    fleet.close()
+
+
+# ------------------------------------------------------------ drain / kill
+
+
+def test_kill_replica_drains_with_zero_drops():
+    fleet, engines, clock = _fleet(replicas=2)
+    handles = [fleet.submit(q) for q in _seqs(6)]
+    killed = fleet.kill_replica(0)
+    assert killed is True
+    assert fleet.alive_replicas() == [1]
+    # replica 0's queued work re-routed to the survivor, nothing dropped
+    assert fleet.cells[1].frontend.depth == 6
+    _drain(fleet, clock)
+    results = [h.result(0) for h in handles]
+    assert all(r.status == "ok" for r in results)
+    stats = fleet.stats()
+    assert stats["fleet.drains"] == 1
+    assert stats["fleet.replica_deaths"] == 1
+    assert stats["fleet.rerouted"] >= 3
+    assert engines[0].dispatched == []  # nothing ran on the dead replica
+    # idempotent: a second kill is a no-op
+    assert fleet.kill_replica(0) is False
+    fleet.close()
+
+
+def test_route_racing_close_gets_structured_rejection_then_reroutes():
+    fleet, engines, clock = _fleet(replicas=2)
+    # a replica whose frontend already closed (drain race): the fleet's
+    # route gets the structured "frontend closed" rejection and re-routes
+    fleet.cells[0].frontend.close(timeout=0.1)
+    orig = fleet._pick_replica
+    fleet._pick_replica = lambda bucket, exclude: (
+        0 if exclude is None else orig(bucket, exclude)
+    )
+    h = fleet.submit("ACDEFG")
+    fleet._pick_replica = orig
+    assert fleet.cells[1].frontend.depth == 1
+    _drain(fleet, clock)
+    assert h.result(0).status == "ok"
+    assert fleet.stats()["fleet.rerouted"] == 1
+    fleet.close()
+
+
+def test_no_alive_replicas_rejects_structurally():
+    fleet, engines, _ = _fleet(replicas=2)
+    fleet.kill_replica(0)
+    fleet.kill_replica(1)
+    h = fleet.submit("ACDEFG")
+    r = h.result(0)
+    assert r.status == "rejected"
+    assert r.error == "no alive replicas"
+    assert fleet.stats()["fleet.no_replica"] == 1
+    fleet.close()
+
+
+def test_fleet_close_rejects_new_submits():
+    fleet, _, _ = _fleet(replicas=2)
+    fleet.close()
+    r = fleet.submit("ACDEFG").result(0)
+    assert r.status == "rejected"
+    assert r.error == "fleet closed"
+
+
+# ----------------------------------------------------------- trace the hop
+
+
+def test_traceparent_hop_reconstructs_complete_traces():
+    tracer = Tracer(enabled=True)
+    fleet, engines, clock = _fleet(replicas=2, tracer=tracer)
+    handles = [fleet.submit(q) for q in _seqs(6)]
+    fleet.kill_replica(0)  # the drill must not orphan lifecycles either
+    _drain(fleet, clock)
+    results = [h.result(0) for h in handles]
+    assert all(r.status == "ok" for r in results)
+    summary = trace_completeness(
+        tracer.events(), [r.trace_id for r in results]
+    )
+    assert summary["fraction"] == 1.0, summary
+    # the router and replica halves share one trace: fleet.admit carries
+    # the root span the replica lifecycle parents onto
+    names_by_tid: dict = {}
+    for e in tracer.events():
+        args = e.get("args", e)
+        tid = args.get("trace_id")
+        if tid:
+            names_by_tid.setdefault(tid, set()).add(e.get("name"))
+    for r in results:
+        names = names_by_tid[r.trace_id]
+        assert "fleet.admit" in names
+        assert "sched.submit" in names
+    fleet.close()
+
+
+# ------------------------------------------------------------ fault plans
+
+
+def test_fleet_fault_plan_parses_kill_and_degrade():
+    kill = FleetFaultPlan.from_spec("replica=1,at_s=2")
+    assert (kill.replica, kill.at_s, kill.kind) == (1, 2.0, "kill")
+    deg = FleetFaultPlan.from_spec("replica=0,at_s=1,degrade=0.05,times=3")
+    assert deg.kind == "degrade"
+    assert deg.degrade_s == 0.05 and deg.times == 3
+    assert FleetFaultPlan.from_spec("") is None
+    assert FleetFaultPlan.from_spec(None) is None
+    with pytest.raises(ValueError):
+        FleetFaultPlan.from_spec("replica=1,bogus=2")
+
+
+def test_fleet_fault_take_is_one_shot():
+    plan = FleetFaultPlan(replica=1, at_s=2.0)
+    assert plan.take(1.0) is None  # not due yet
+    assert plan.take(2.5) == "kill"
+    assert plan.take(3.0) is None  # budget spent
+    assert len(plan.fired) == 1
+
+
+def test_degrade_plan_is_match_all_delay_only():
+    deg = FleetFaultPlan(replica=0, degrade_s=0.01).degrade_plan()
+    assert deg.match_all and not deg.fail and deg.times == 0
+    assert deg._matches(7, 16) and deg._matches(1, 8)
+    deg.on_dispatch(1, 8)  # must not raise
+    assert deg.fired == [{"dispatch": 1, "bucket": 8}]
+
+
+def test_pump_health_fires_kill_fault():
+    fault = FleetFaultPlan(replica=1, at_s=5.0)
+    fleet, engines, clock = _fleet(replicas=2, fault=fault)
+    assert fleet.pump_health()["killed"] is None  # not due
+    clock.advance(6.0)
+    assert fleet.pump_health()["killed"] == 1
+    assert fleet.alive_replicas() == [0]
+    fleet.close()
+
+
+def test_pump_health_fires_degrade_fault():
+    fault = FleetFaultPlan(replica=0, at_s=0.0, degrade_s=0.01)
+    fleet, engines, clock = _fleet(replicas=2, fault=fault)
+    clock.advance(1.0)
+    assert fleet.pump_health()["degraded"] == 0
+    assert engines[0].faults.match_all and not engines[0].faults.fail
+    assert fleet.alive_replicas() == [0, 1]  # degraded, not dead
+    fleet.close()
+
+
+# ------------------------------------------------------------- SLO fan-out
+
+
+def test_slo_fanout_and_fleet_aggregation():
+    from alphafold2_tpu.observe.slo import SLOSpec
+
+    specs = [SLOSpec(name="availability", objective="availability",
+                     target=0.9, min_events=1)]
+    fleet, engines, clock = _fleet(replicas=2, slo_specs=specs)
+    handles = [fleet.submit(q) for q in _seqs(4)]
+    _drain(fleet, clock)
+    assert all(h.result(0).status == "ok" for h in handles)
+    summary = fleet.slo_summary()
+    assert len(summary["replicas"]) == 2
+    agg = summary["fleet"]
+    assert len(agg) == 1 and agg[0]["spec"] == "availability"
+    assert agg[0]["fast_events"] == 4  # summed across replicas
+    assert agg[0]["replicas"] == 2
+    assert agg[0]["alert"] is False
+    fleet.close()
+
+
+def test_aggregate_slo_verdicts_weights_burn_by_events():
+    base = {"spec": "latency", "objective": "latency", "class": "all",
+            "target": 0.99, "burn_threshold": 2.0}
+    hot = dict(base, fast_burn=4.0, slow_burn=4.0,
+               fast_events=10, slow_events=10, alert=True)
+    idle = dict(base, fast_burn=0.0, slow_burn=0.0,
+                fast_events=0, slow_events=0, alert=False)
+    agg = aggregate_slo_verdicts([[hot], [idle]])
+    assert agg[0]["fast_burn"] == 4.0  # the idle replica cannot dilute
+    assert agg[0]["fast_events"] == 10
+    assert agg[0]["alert"] is True
+
+
+# ------------------------------------------------- counters and exposition
+
+
+def test_snapshot_zero_seeds_every_fleet_counter():
+    fleet, _, _ = _fleet(replicas=2)
+    snap = fleet.snapshot()
+    for key in fleet_counter_zeros(2):
+        assert key in snap, key
+    assert snap["fleet.steals"] == 0
+    assert snap["fleet.replica0.alive"] == 1
+    assert snap["fleet.replica1.depth"] == 0
+    fleet.close()
+
+
+# -------------------------------------------------- lock-order (layer 5)
+
+
+def test_router_never_holds_its_lock_into_a_replica_lock():
+    """The fleet's deadlock cliff, statically: the committed contract
+    shape must contain no FleetFrontend._lock -> AsyncServeFrontend._lock
+    edge (the gated defect in fleet.py is excluded from contracts by
+    design and exists to prove the gate notices one)."""
+    from alphafold2_tpu.analysis.concurrency import compute_contracts
+
+    contracts = compute_contracts()
+    forbidden = [
+        edge for edge in contracts["lock_graph"]
+        if edge.startswith("FleetFrontend._lock ->")
+        and "AsyncServeFrontend._lock" in edge
+    ]
+    assert forbidden == [], forbidden
+    # the router's own guarded state IS in the contract
+    assert "FleetFrontend" in contracts["guards"]
+
+
+def test_match_all_fault_plan_hits_any_dispatch():
+    plan = FaultPlan(match_all=True, times=2)
+    with pytest.raises(Exception):
+        plan.on_dispatch(3, 16)
+    with pytest.raises(Exception):
+        plan.on_dispatch(9, 8)
+    plan.on_dispatch(11, 8)  # budget of 2 spent: inert
+    assert len(plan.fired) == 2
